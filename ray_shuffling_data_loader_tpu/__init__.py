@@ -1,0 +1,13 @@
+"""TPU-native shuffling data loader.
+
+A from-scratch JAX/TPU framework with the capabilities of
+``vvksh/ray_shuffling_data_loader`` (see SURVEY.md): per-epoch map/reduce
+shuffle over Parquet with epoch pipelining, multi-queue batch transport,
+rank-aware iterable datasets, and an accelerator binding that lands batches
+as sharded ``jax.Array``s in HBM — plus a seeded-PRNG determinism story,
+loader checkpoint/resume, stats, and a benchmark harness.
+
+Public exports mirror the reference's (reference: __init__.py:1-11).
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,207 @@
+"""Native (C++) host kernels, loaded via ctypes.
+
+The reference's native substrate is Ray's C++ core (SURVEY.md §2.3). Here the
+native layer is a small shared library built from ``src/shuffle_native.cpp``
+at first import (g++ -O3, cached next to the source). Everything has a NumPy
+fallback, so the package works even when no compiler is present — but the
+native path is the default on TPU-VM hosts.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "shuffle_native.cpp")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "src", "libshuffle_native.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+_load_lock = threading.Lock()
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O3", "-march=native", "-std=c++17", "-shared", "-fPIC",
+        "-pthread", _SRC, "-o", _LIB_PATH,
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True, timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return proc.returncode == 0
+
+
+def _bind(lib: ctypes.CDLL) -> None:
+    i64, u64, u32p, i64p, f64p = (ctypes.c_int64, ctypes.c_uint64,
+                                  ctypes.POINTER(ctypes.c_uint32),
+                                  ctypes.POINTER(ctypes.c_int64),
+                                  ctypes.POINTER(ctypes.c_double))
+    lib.rsdl_partition_indices.argtypes = [u32p, i64, i64, i64p, i64p]
+    lib.rsdl_partition_indices.restype = ctypes.c_int
+    lib.rsdl_fill_random_int64.argtypes = [i64p, i64, i64, u64, ctypes.c_int]
+    lib.rsdl_fill_random_int64.restype = None
+    lib.rsdl_fill_random_double.argtypes = [f64p, i64, u64, ctypes.c_int]
+    lib.rsdl_fill_random_double.restype = None
+    lib.rsdl_buffer_alloc.argtypes = [i64]
+    lib.rsdl_buffer_alloc.restype = i64
+    lib.rsdl_buffer_data.argtypes = [i64]
+    lib.rsdl_buffer_data.restype = ctypes.c_void_p
+    lib.rsdl_buffer_size.argtypes = [i64]
+    lib.rsdl_buffer_size.restype = i64
+    lib.rsdl_buffer_incref.argtypes = [i64]
+    lib.rsdl_buffer_incref.restype = i64
+    lib.rsdl_buffer_decref.argtypes = [i64]
+    lib.rsdl_buffer_decref.restype = i64
+    lib.rsdl_buffer_bytes_in_use.argtypes = []
+    lib.rsdl_buffer_bytes_in_use.restype = i64
+    lib.rsdl_buffer_count.argtypes = []
+    lib.rsdl_buffer_count.restype = i64
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    with _load_lock:
+        if _load_attempted:
+            return _lib
+        if os.environ.get("RSDL_TPU_DISABLE_NATIVE"):
+            _load_attempted = True
+            return None
+        try:
+            needs_build = (not os.path.exists(_LIB_PATH)
+                           or os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC))
+            if needs_build and not _build():
+                return None
+            lib = ctypes.CDLL(_LIB_PATH)
+            _bind(lib)
+            _lib = lib
+        except (OSError, AttributeError):
+            # AttributeError = stale .so missing a newly-bound symbol; try one
+            # rebuild, then fall back to NumPy permanently.
+            try:
+                if _build():
+                    lib = ctypes.CDLL(_LIB_PATH)
+                    _bind(lib)
+                    _lib = lib
+            except (OSError, AttributeError):
+                _lib = None
+        finally:
+            _load_attempted = True
+        return _lib
+
+
+def available() -> bool:
+    """True if the native library is built and loaded."""
+    return _load() is not None
+
+
+def partition_indices(assignments: np.ndarray,
+                      num_reducers: int) -> List[np.ndarray]:
+    """O(n) stable counting-sort partition (see ops/partition.py docstring)."""
+    if num_reducers < 1:
+        raise ValueError(f"num_reducers must be >= 1, got {num_reducers}")
+    lib = _load()
+    assert lib is not None
+    assignments = np.ascontiguousarray(assignments, dtype=np.uint32)
+    n = len(assignments)
+    out = np.empty(n, dtype=np.int64)
+    offsets = np.empty(num_reducers + 1, dtype=np.int64)
+    rc = lib.rsdl_partition_indices(
+        assignments.ctypes.data_as(ctypes.POINTER(ctypes.c_uint32)), n,
+        num_reducers, out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if rc != 0:
+        raise ValueError(
+            f"assignment value out of range for num_reducers={num_reducers}")
+    return [out[offsets[r]:offsets[r + 1]] for r in range(num_reducers)]
+
+
+def fill_random_int64(n: int, bound: int, seed: int,
+                      nthreads: int = 0) -> np.ndarray:
+    """Threaded uniform int64 fill in [0, bound)."""
+    if bound < 1:
+        raise ValueError(f"bound must be >= 1, got {bound}")
+    lib = _load()
+    assert lib is not None
+    if nthreads <= 0:
+        nthreads = min(8, os.cpu_count() or 1)
+    out = np.empty(n, dtype=np.int64)
+    lib.rsdl_fill_random_int64(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, bound,
+        seed & 0xFFFFFFFFFFFFFFFF, nthreads)
+    return out
+
+
+def fill_random_double(n: int, seed: int, nthreads: int = 0) -> np.ndarray:
+    """Threaded uniform double fill in [0, 1)."""
+    lib = _load()
+    assert lib is not None
+    if nthreads <= 0:
+        nthreads = min(8, os.cpu_count() or 1)
+    out = np.empty(n, dtype=np.float64)
+    lib.rsdl_fill_random_double(
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n,
+        seed & 0xFFFFFFFFFFFFFFFF, nthreads)
+    return out
+
+
+class NativeBufferPool:
+    """Thin Python handle over the C++ ref-counted host buffer pool.
+
+    Plasma-equivalent role (SURVEY.md §2.3): host-RAM buffers with explicit
+    refcounts so the shuffle's memory footprint is observable and bounded.
+    """
+
+    def alloc(self, size: int) -> int:
+        if size < 0:
+            raise ValueError(f"buffer size must be >= 0, got {size}")
+        lib = _load()
+        assert lib is not None
+        buf_id = lib.rsdl_buffer_alloc(size)
+        if buf_id == 0:
+            raise MemoryError(f"native buffer alloc of {size} bytes failed")
+        return buf_id
+
+    def view(self, buf_id: int) -> np.ndarray:
+        """uint8 view of the buffer (no copy, no ownership transfer)."""
+        lib = _load()
+        assert lib is not None
+        size = lib.rsdl_buffer_size(buf_id)
+        if size < 0:
+            raise KeyError(f"unknown buffer id {buf_id}")
+        data = lib.rsdl_buffer_data(buf_id)
+        return np.ctypeslib.as_array(
+            ctypes.cast(data, ctypes.POINTER(ctypes.c_uint8)), shape=(size,))
+
+    def incref(self, buf_id: int) -> int:
+        lib = _load()
+        assert lib is not None
+        count = lib.rsdl_buffer_incref(buf_id)
+        if count < 0:
+            raise KeyError(f"unknown buffer id {buf_id}")
+        return count
+
+    def decref(self, buf_id: int) -> int:
+        lib = _load()
+        assert lib is not None
+        count = lib.rsdl_buffer_decref(buf_id)
+        if count < 0:
+            raise KeyError(f"unknown buffer id {buf_id}")
+        return count
+
+    def bytes_in_use(self) -> int:
+        lib = _load()
+        assert lib is not None
+        return lib.rsdl_buffer_bytes_in_use()
+
+    def buffer_count(self) -> int:
+        lib = _load()
+        assert lib is not None
+        return lib.rsdl_buffer_count()

@@ -1,0 +1,218 @@
+// Native host-side kernels for the TPU shuffling data loader.
+//
+// The reference delegates its native work to Ray's C++ core (plasma object
+// store + raylet; see SURVEY.md §2.3). Our runtime is host-local per TPU-VM,
+// so the native components we need are the hot host-CPU kernels and a
+// ref-counted host buffer pool:
+//
+//   - partition_indices: O(n) stable counting-sort of row indices by reducer
+//     assignment (replaces the reference's O(n * num_reducers) boolean-mask
+//     partition, reference: shuffle.py:215-218).
+//   - fill_random_*: threaded xoshiro256** generators for the synthetic data
+//     generator hot loop (reference: data_generation.py:98-110).
+//   - buffer pool: aligned host allocations with explicit refcounts
+//     (replaces plasma ref-counted buffers, SURVEY.md §2.3).
+//
+// Exposed with a plain C ABI and loaded from Python via ctypes.
+
+#include <atomic>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Partition kernel
+// ---------------------------------------------------------------------------
+
+// Stable counting sort: out_indices[i] receives the row indices assigned to
+// reducer i, in original row order. out_indices must have room for n int64s,
+// laid out contiguously; out_offsets gets num_reducers+1 entries.
+// Returns 0 on success, -1 if any assignment is >= num_reducers (in which
+// case no output is written).
+int rsdl_partition_indices(const uint32_t* assignments, int64_t n,
+                           int64_t num_reducers, int64_t* out_indices,
+                           int64_t* out_offsets) {
+  if (num_reducers < 1) return -1;
+  std::vector<int64_t> counts(num_reducers, 0);
+  const uint64_t bound = static_cast<uint64_t>(num_reducers);
+  for (int64_t i = 0; i < n; ++i) {
+    if (assignments[i] >= bound) return -1;
+    counts[assignments[i]]++;
+  }
+  out_offsets[0] = 0;
+  for (int64_t r = 0; r < num_reducers; ++r)
+    out_offsets[r + 1] = out_offsets[r] + counts[r];
+  std::vector<int64_t> cursor(out_offsets, out_offsets + num_reducers);
+  for (int64_t i = 0; i < n; ++i) out_indices[cursor[assignments[i]]++] = i;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Threaded random fill (xoshiro256**) for synthetic data generation
+// ---------------------------------------------------------------------------
+
+static inline uint64_t rotl(uint64_t x, int k) {
+  return (x << k) | (x >> (64 - k));
+}
+
+struct Xoshiro256 {
+  uint64_t s[4];
+  explicit Xoshiro256(uint64_t seed) {
+    // splitmix64 seeding
+    uint64_t z = seed;
+    for (int i = 0; i < 4; ++i) {
+      z += 0x9e3779b97f4a7c15ULL;
+      uint64_t t = z;
+      t = (t ^ (t >> 30)) * 0xbf58476d1ce4e5b9ULL;
+      t = (t ^ (t >> 27)) * 0x94d049bb133111ebULL;
+      s[i] = t ^ (t >> 31);
+    }
+  }
+  inline uint64_t next() {
+    uint64_t result = rotl(s[1] * 5, 7) * 9;
+    uint64_t t = s[1] << 17;
+    s[2] ^= s[0];
+    s[3] ^= s[1];
+    s[1] ^= s[2];
+    s[0] ^= s[3];
+    s[2] ^= t;
+    s[3] = rotl(s[3], 45);
+    return result;
+  }
+};
+
+// Fill out[0..n) with uniform int64 in [0, bound) using nthreads threads.
+// bound must be >= 1 (validated by the Python wrapper; guarded here too).
+void rsdl_fill_random_int64(int64_t* out, int64_t n, int64_t bound,
+                            uint64_t seed, int nthreads) {
+  if (bound < 1) bound = 1;
+  if (nthreads < 1) nthreads = 1;
+  auto work = [&](int t) {
+    int64_t lo = n * t / nthreads, hi = n * (t + 1) / nthreads;
+    Xoshiro256 rng(seed * 0x100000001b3ULL + t + 1);
+    // Rejection-free modulo is fine for data generation (bias < 2^-40 for
+    // the cardinalities involved).
+    for (int64_t i = lo; i < hi; ++i)
+      out[i] = static_cast<int64_t>(rng.next() % static_cast<uint64_t>(bound));
+  };
+  if (nthreads == 1) {
+    work(0);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t) threads.emplace_back(work, t);
+  for (auto& th : threads) th.join();
+}
+
+// Fill out[0..n) with uniform doubles in [0, 1).
+void rsdl_fill_random_double(double* out, int64_t n, uint64_t seed,
+                             int nthreads) {
+  if (nthreads < 1) nthreads = 1;
+  auto work = [&](int t) {
+    int64_t lo = n * t / nthreads, hi = n * (t + 1) / nthreads;
+    Xoshiro256 rng(seed * 0x9e3779b97f4a7c15ULL + t + 1);
+    for (int64_t i = lo; i < hi; ++i)
+      out[i] = (rng.next() >> 11) * 0x1.0p-53;
+  };
+  if (nthreads == 1) {
+    work(0);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t) threads.emplace_back(work, t);
+  for (auto& th : threads) th.join();
+}
+
+// ---------------------------------------------------------------------------
+// Ref-counted host buffer pool
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct Buffer {
+  void* data;
+  int64_t size;
+  std::atomic<int64_t> refcount;
+  Buffer(void* d, int64_t s) : data(d), size(s), refcount(1) {}
+};
+
+std::mutex g_pool_mutex;
+std::unordered_map<int64_t, Buffer*> g_pool;
+int64_t g_next_id = 1;
+std::atomic<int64_t> g_bytes_in_use{0};
+
+}  // namespace
+
+// Allocate a 64-byte-aligned buffer; returns an id (0 on failure or
+// negative size).
+int64_t rsdl_buffer_alloc(int64_t size) {
+  if (size < 0) return 0;
+  void* data = nullptr;
+  if (posix_memalign(&data, 64, static_cast<size_t>(size > 0 ? size : 1)) != 0)
+    return 0;
+  auto* buf = new Buffer(data, size);
+  std::lock_guard<std::mutex> lock(g_pool_mutex);
+  int64_t id = g_next_id++;
+  g_pool[id] = buf;
+  g_bytes_in_use.fetch_add(size);
+  return id;
+}
+
+void* rsdl_buffer_data(int64_t id) {
+  std::lock_guard<std::mutex> lock(g_pool_mutex);
+  auto it = g_pool.find(id);
+  return it == g_pool.end() ? nullptr : it->second->data;
+}
+
+int64_t rsdl_buffer_size(int64_t id) {
+  std::lock_guard<std::mutex> lock(g_pool_mutex);
+  auto it = g_pool.find(id);
+  return it == g_pool.end() ? -1 : it->second->size;
+}
+
+// Increment refcount; returns new count or -1 if unknown id.
+int64_t rsdl_buffer_incref(int64_t id) {
+  std::lock_guard<std::mutex> lock(g_pool_mutex);
+  auto it = g_pool.find(id);
+  if (it == g_pool.end()) return -1;
+  return it->second->refcount.fetch_add(1) + 1;
+}
+
+// Decrement refcount; frees at zero. Returns new count or -1 if unknown id.
+int64_t rsdl_buffer_decref(int64_t id) {
+  Buffer* to_free = nullptr;
+  int64_t count;
+  {
+    std::lock_guard<std::mutex> lock(g_pool_mutex);
+    auto it = g_pool.find(id);
+    if (it == g_pool.end()) return -1;
+    count = it->second->refcount.fetch_sub(1) - 1;
+    if (count == 0) {
+      to_free = it->second;
+      g_pool.erase(it);
+      g_bytes_in_use.fetch_sub(to_free->size);
+    }
+  }
+  if (to_free != nullptr) {
+    free(to_free->data);
+    delete to_free;
+  }
+  return count;
+}
+
+int64_t rsdl_buffer_bytes_in_use() { return g_bytes_in_use.load(); }
+
+int64_t rsdl_buffer_count() {
+  std::lock_guard<std::mutex> lock(g_pool_mutex);
+  return static_cast<int64_t>(g_pool.size());
+}
+
+}  // extern "C"

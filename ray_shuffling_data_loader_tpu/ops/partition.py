@@ -1,0 +1,111 @@
+"""Seeded row partitioning and permutation primitives.
+
+These are the statistical heart of the shuffle. The reference draws an
+unseeded uniform reducer id per row in its map task
+(reference: shuffle.py:213) and does an unseeded ``df.sample(frac=1)``
+permutation in its reduce task (reference: shuffle.py:240); both are
+irreproducible. We keep the same two-stage statistical structure
+(uniform multinomial row->reducer assignment, then within-reducer
+permutation) but derive every random stream from an explicit
+``(seed, epoch, task)`` key via ``np.random.SeedSequence`` so an epoch's
+shuffle is exactly replayable — which is what makes loader
+checkpoint/resume possible.
+
+The hot paths (``partition_indices``) dispatch to the native C++ kernel in
+``ray_shuffling_data_loader_tpu.native`` when it is available and fall back
+to NumPy otherwise.
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+# Stream-domain tags so map and reduce tasks for the same (seed, epoch)
+# never share a random stream.
+_MAP_STREAM = 0
+_REDUCE_STREAM = 1
+
+
+def map_rng(seed: int, epoch: int, file_index: int) -> np.random.Generator:
+    """PRNG for the map task of ``file_index`` in ``epoch``."""
+    seq = np.random.SeedSequence(entropy=seed,
+                                 spawn_key=(_MAP_STREAM, epoch, file_index))
+    return np.random.Generator(np.random.Philox(seq))
+
+
+def reduce_rng(seed: int, epoch: int, reducer_index: int) -> np.random.Generator:
+    """PRNG for the reduce task of ``reducer_index`` in ``epoch``."""
+    seq = np.random.SeedSequence(entropy=seed,
+                                 spawn_key=(_REDUCE_STREAM, epoch, reducer_index))
+    return np.random.Generator(np.random.Philox(seq))
+
+
+def assign_reducers(num_rows: int, num_reducers: int,
+                    rng: np.random.Generator) -> np.ndarray:
+    """Uniformly assign each of ``num_rows`` rows to a reducer.
+
+    Mirrors ``np.random.randint(num_reducers, size=len(rows))``
+    (reference: shuffle.py:213) but seeded.
+    """
+    return rng.integers(0, num_reducers, size=num_rows, dtype=np.uint32)
+
+
+def partition_indices(assignments: np.ndarray,
+                      num_reducers: int) -> List[np.ndarray]:
+    """Stable-partition row indices by reducer assignment.
+
+    Returns ``num_reducers`` int64 index arrays; concatenated they are a
+    permutation of ``arange(len(assignments))``. Stability (original row
+    order preserved within a partition) keeps the shuffle's statistics
+    identical to the reference's boolean-mask partitioning
+    (reference: shuffle.py:215-218) at O(n) instead of O(n * num_reducers).
+    """
+    from ray_shuffling_data_loader_tpu import native
+    if native.available():
+        return native.partition_indices(assignments, num_reducers)
+    return partition_indices_numpy(assignments, num_reducers)
+
+
+def partition_indices_numpy(assignments: np.ndarray,
+                            num_reducers: int) -> List[np.ndarray]:
+    """Pure-NumPy fallback for :func:`partition_indices`."""
+    if num_reducers < 1:
+        raise ValueError(f"num_reducers must be >= 1, got {num_reducers}")
+    counts = np.bincount(assignments, minlength=num_reducers)
+    if len(counts) > num_reducers:
+        raise ValueError(
+            f"assignment value out of range for num_reducers={num_reducers}")
+    order = np.argsort(assignments, kind="stable").astype(np.int64, copy=False)
+    splits = np.cumsum(counts)[:-1]
+    return [part for part in np.split(order, splits)]
+
+
+def permutation(num_rows: int, rng: np.random.Generator) -> np.ndarray:
+    """Uniform permutation of ``arange(num_rows)``.
+
+    Mirrors ``df.sample(frac=1)`` (reference: shuffle.py:240) but seeded.
+    """
+    return rng.permutation(num_rows)
+
+
+def split_sizes(total: int, num_parts: int) -> List[int]:
+    """Sizes produced by ``np.array_split(range(total), num_parts)``.
+
+    The reference routes reducer outputs to trainers with ``np.array_split``
+    (reference: shuffle.py:188-189); we reproduce its contiguous,
+    remainder-first split arithmetic exactly.
+    """
+    base, rem = divmod(total, num_parts)
+    return [base + 1 if i < rem else base for i in range(num_parts)]
+
+
+def contiguous_splits(items: Sequence, num_parts: int) -> List[list]:
+    """Contiguous split of ``items`` into ``num_parts`` groups, array_split-style."""
+    out: List[list] = []
+    start = 0
+    for size in split_sizes(len(items), num_parts):
+        out.append(list(items[start:start + size]))
+        start += size
+    return out

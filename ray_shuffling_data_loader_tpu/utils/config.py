@@ -1,0 +1,63 @@
+"""Configuration surface for the TPU shuffling data loader.
+
+The reference exposes configuration as constructor kwargs plus module
+constants (reference: ray_shuffling_data_loader/dataset.py:11-12,75-86).
+We keep the kwargs surface and add a small dataclass so programmatic
+configuration is explicit and testable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+# Fraction of host cores given to reducers when num_reducers is not set.
+# Mirrors REDUCER_CLUSTER_CORE_SHARE = 0.6 (reference: dataset.py:12) but
+# scoped to the local TPU-VM host rather than a Ray cluster.
+REDUCER_HOST_CORE_SHARE = 0.6
+
+# Default number of epochs whose shuffles may be in flight concurrently.
+DEFAULT_MAX_CONCURRENT_EPOCHS = 2
+
+
+def default_num_reducers(num_trainers: int, num_cpus: Optional[int] = None) -> int:
+    """Default reducer count: num_trainers * host_cpus * REDUCER_HOST_CORE_SHARE.
+
+    Mirrors the reference's formula (reference: dataset.py:87-89) with the
+    TPU-VM host's CPU count in place of the Ray cluster master's.
+    """
+    if num_cpus is None:
+        num_cpus = os.cpu_count() or 1
+    return max(1, int(num_trainers * num_cpus * REDUCER_HOST_CORE_SHARE))
+
+
+@dataclasses.dataclass(frozen=True)
+class ShuffleConfig:
+    """Static configuration for a multi-epoch shuffle.
+
+    Mirrors the kwargs of the reference's ``shuffle()`` entrypoint
+    (reference: shuffle.py:79-85) plus a deterministic ``seed`` (the
+    reference uses unseeded np.random — see SURVEY.md §5 — so its epochs
+    are not reproducible; ours are).
+    """
+
+    num_epochs: int
+    num_reducers: int
+    num_trainers: int
+    max_concurrent_epochs: int = DEFAULT_MAX_CONCURRENT_EPOCHS
+    seed: int = 0
+    # Number of worker threads for map/reduce tasks; None = os.cpu_count().
+    num_workers: Optional[int] = None
+    collect_stats: bool = True
+
+    def __post_init__(self) -> None:
+        if self.num_epochs < 1:
+            raise ValueError(f"num_epochs must be >= 1, got {self.num_epochs}")
+        if self.num_reducers < 1:
+            raise ValueError(f"num_reducers must be >= 1, got {self.num_reducers}")
+        if self.num_trainers < 1:
+            raise ValueError(f"num_trainers must be >= 1, got {self.num_trainers}")
+        if self.max_concurrent_epochs < 1:
+            raise ValueError(
+                f"max_concurrent_epochs must be >= 1, got {self.max_concurrent_epochs}")

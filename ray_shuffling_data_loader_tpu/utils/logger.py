@@ -1,0 +1,35 @@
+"""Logging setup for the TPU shuffling data loader.
+
+Capability parity with the reference's ``logger.py`` (reference:
+ray_shuffling_data_loader/logger.py:4-13): a per-module stream logger with a
+module/function format string. Differences: level is configurable via the
+``RSDL_TPU_LOG_LEVEL`` environment variable (the reference hardcodes DEBUG),
+and handlers are installed only once per logger name.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(asctime)s %(levelname)s %(name)s %(module)s.%(funcName)s:%(lineno)d -- %(message)s"
+
+
+def setup_custom_logger(name: str) -> logging.Logger:
+    """Return a configured logger for ``name``.
+
+    Idempotent: calling twice with the same name does not duplicate handlers.
+    """
+    logger = logging.getLogger(name)
+    if getattr(logger, "_rsdl_tpu_configured", False):
+        return logger
+    level_name = os.environ.get("RSDL_TPU_LOG_LEVEL", "INFO").upper()
+    level = getattr(logging, level_name, logging.INFO)
+    logger.setLevel(level)
+    handler = logging.StreamHandler(stream=sys.stderr)
+    handler.setFormatter(logging.Formatter(_FORMAT))
+    logger.addHandler(handler)
+    logger.propagate = False
+    logger._rsdl_tpu_configured = True  # type: ignore[attr-defined]
+    return logger

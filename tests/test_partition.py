@@ -1,0 +1,164 @@
+"""Tests for the seeded partition/permutation primitives (ops/partition.py)."""
+
+import numpy as np
+import pytest
+
+from ray_shuffling_data_loader_tpu import native
+from ray_shuffling_data_loader_tpu.ops import partition as P
+
+
+def test_map_rng_deterministic():
+    a = P.assign_reducers(1000, 7, P.map_rng(seed=42, epoch=3, file_index=5))
+    b = P.assign_reducers(1000, 7, P.map_rng(seed=42, epoch=3, file_index=5))
+    np.testing.assert_array_equal(a, b)
+
+
+def test_map_rng_distinct_streams():
+    base = P.assign_reducers(1000, 7, P.map_rng(42, 0, 0))
+    for epoch, fidx in [(0, 1), (1, 0), (1, 1)]:
+        other = P.assign_reducers(1000, 7, P.map_rng(42, epoch, fidx))
+        assert not np.array_equal(base, other)
+
+
+def test_map_reduce_streams_disjoint():
+    a = P.map_rng(7, 2, 4).integers(0, 2**63, size=8)
+    b = P.reduce_rng(7, 2, 4).integers(0, 2**63, size=8)
+    assert not np.array_equal(a, b)
+
+
+def test_assign_reducers_uniform():
+    rng = P.map_rng(0, 0, 0)
+    n, k = 200_000, 8
+    counts = np.bincount(P.assign_reducers(n, k, rng), minlength=k)
+    # Each bucket should be within 5 sigma of n/k.
+    expected = n / k
+    sigma = np.sqrt(n * (1 / k) * (1 - 1 / k))
+    assert np.all(np.abs(counts - expected) < 5 * sigma)
+
+
+@pytest.mark.parametrize("impl", ["numpy", "native"])
+def test_partition_indices_is_stable_partition(impl):
+    if impl == "native" and not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(1)
+    n, k = 10_000, 13
+    assignments = rng.integers(0, k, size=n, dtype=np.uint32)
+    fn = (native.partition_indices
+          if impl == "native" else P.partition_indices_numpy)
+    parts = fn(assignments, k)
+    assert len(parts) == k
+    # Concatenation is a permutation of arange(n).
+    allidx = np.concatenate(parts)
+    assert len(allidx) == n
+    np.testing.assert_array_equal(np.sort(allidx), np.arange(n))
+    for r, idx in enumerate(parts):
+        # Correct membership and stability (sorted = original row order).
+        np.testing.assert_array_equal(assignments[idx], r)
+        np.testing.assert_array_equal(idx, np.sort(idx))
+
+
+def test_partition_native_matches_numpy():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    rng = np.random.default_rng(2)
+    assignments = rng.integers(0, 5, size=4321, dtype=np.uint32)
+    a = native.partition_indices(assignments, 5)
+    b = P.partition_indices_numpy(assignments, 5)
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x, y)
+
+
+def test_partition_empty_reducers():
+    assignments = np.zeros(10, dtype=np.uint32)  # everything to reducer 0
+    parts = P.partition_indices_numpy(assignments, 4)
+    assert [len(p) for p in parts] == [10, 0, 0, 0]
+    if native.available():
+        nparts = native.partition_indices(assignments, 4)
+        assert [len(p) for p in nparts] == [10, 0, 0, 0]
+
+
+def test_permutation_seeded():
+    a = P.permutation(100, P.reduce_rng(9, 1, 2))
+    b = P.permutation(100, P.reduce_rng(9, 1, 2))
+    np.testing.assert_array_equal(a, b)
+    np.testing.assert_array_equal(np.sort(a), np.arange(100))
+
+
+def test_split_sizes_matches_array_split():
+    for total in [0, 1, 7, 10, 23]:
+        for parts in [1, 2, 3, 7]:
+            ours = P.split_sizes(total, parts)
+            theirs = [len(c) for c in np.array_split(np.arange(total), parts)]
+            assert ours == theirs, (total, parts)
+
+
+def test_contiguous_splits():
+    groups = P.contiguous_splits(list(range(10)), 3)
+    assert groups == [[0, 1, 2, 3], [4, 5, 6], [7, 8, 9]]
+
+
+def test_native_buffer_pool():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    pool = native.NativeBufferPool()
+    before = pool.bytes_in_use()
+    bid = pool.alloc(1024)
+    assert pool.bytes_in_use() == before + 1024
+    view = pool.view(bid)
+    view[:] = 7
+    assert pool.view(bid)[123] == 7
+    assert pool.incref(bid) == 2
+    assert pool.decref(bid) == 1
+    assert pool.decref(bid) == 0
+    assert pool.bytes_in_use() == before
+    with pytest.raises(KeyError):
+        pool.view(bid)
+
+
+def test_native_fill_random():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    a = native.fill_random_int64(10_000, 100, seed=3, nthreads=4)
+    b = native.fill_random_int64(10_000, 100, seed=3, nthreads=4)
+    np.testing.assert_array_equal(a, b)
+    assert a.min() >= 0 and a.max() < 100
+    # Roughly uniform.
+    counts = np.bincount(a, minlength=100)
+    assert counts.min() > 20
+    d = native.fill_random_double(10_000, seed=3)
+    assert d.min() >= 0.0 and d.max() < 1.0
+    assert 0.45 < d.mean() < 0.55
+
+
+def test_partition_out_of_range_raises():
+    bad = np.array([0, 1, 5, 2], dtype=np.uint32)
+    with pytest.raises(ValueError):
+        P.partition_indices_numpy(bad, 3)
+    if native.available():
+        with pytest.raises(ValueError):
+            native.partition_indices(bad, 3)
+
+
+def test_buffer_alloc_negative_raises():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    pool = native.NativeBufferPool()
+    with pytest.raises(ValueError):
+        pool.alloc(-5)
+
+
+def test_bad_num_reducers_raises():
+    a = np.zeros(4, dtype=np.uint32)
+    for k in (0, -1):
+        with pytest.raises(ValueError):
+            P.partition_indices_numpy(a, k)
+        if native.available():
+            with pytest.raises(ValueError):
+                native.partition_indices(a, k)
+
+
+def test_fill_random_bad_bound_raises():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    with pytest.raises(ValueError):
+        native.fill_random_int64(10, 0, seed=1)
