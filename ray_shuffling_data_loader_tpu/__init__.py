@@ -11,3 +11,19 @@ Public exports mirror the reference's (reference: __init__.py:1-11).
 """
 
 __version__ = "0.1.0"
+
+from ray_shuffling_data_loader_tpu.dataset import (  # noqa: E402,F401
+    ShufflingDataset, create_batch_queue_and_shuffle)
+from ray_shuffling_data_loader_tpu.multiqueue import MultiQueue  # noqa: E402,F401
+from ray_shuffling_data_loader_tpu.shuffle import (  # noqa: E402,F401
+    shuffle, shuffle_with_stats, shuffle_no_stats)
+
+__all__ = [
+    "ShufflingDataset",
+    "MultiQueue",
+    "shuffle",
+    "shuffle_with_stats",
+    "shuffle_no_stats",
+    "create_batch_queue_and_shuffle",
+    "__version__",
+]

@@ -1,0 +1,227 @@
+"""Framework-agnostic shuffling dataset API.
+
+Capability parity with the reference's L3 dataset layer (reference:
+dataset.py:17-230): a rank-aware iterable dataset where rank 0 creates the
+batch queue and launches the multi-epoch shuffle while other ranks connect
+by name; the iterator pops reducer-output refs from its per-(epoch, rank)
+queue, materializes them, and re-chunks variable-size reducer outputs into
+exact ``batch_size``-row batches with a leftover carry buffer, ``drop_last``
+handling, a ``set_epoch`` misuse guard, and a join on the shuffle driver
+after the final epoch.
+
+TPU-native differences: batches are pyarrow Tables (zero-copy slices of
+Arrow buffers) rather than pandas DataFrames; the shuffle driver is a
+background thread task rather than a Ray remote task; and a ``seed``
+parameter makes every epoch's order replayable. The JAX binding that turns
+these tables into device-sharded ``jax.Array`` batches lives in
+jax_dataset.py (L4).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Iterator, List, Optional, Sequence
+
+import pyarrow as pa
+
+from ray_shuffling_data_loader_tpu import executor as ex
+from ray_shuffling_data_loader_tpu import multiqueue as mq
+from ray_shuffling_data_loader_tpu import shuffle as sh
+from ray_shuffling_data_loader_tpu.utils.config import default_num_reducers
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+# Well-known queue name (reference: dataset.py:11 MULTIQUEUE_ACTOR_NAME).
+MULTIQUEUE_NAME = "MultiQueue"
+
+
+def batch_consumer(queue: mq.MultiQueue,
+                   num_trainers: int,
+                   rank: int,
+                   epoch: int,
+                   batches: Optional[Sequence[ex.TaskRef]]) -> None:
+    """Glue given to the shuffler: route reducer refs into the right queue
+    (reference: dataset.py:213-224). ``None`` is the epoch-end sentinel."""
+    queue_idx = epoch * num_trainers + rank
+    if batches is None:
+        queue.put(queue_idx, None)
+    else:
+        queue.put_batch(queue_idx, list(batches))
+
+
+def debug_batch_consumer(rank: int,
+                         epoch: int,
+                         batches: Optional[Sequence[ex.TaskRef]]) -> None:
+    """Print-only consumer for eyeballing the shuffle alone
+    (reference: dataset.py:227-230)."""
+    num_batches = len(batches) if batches is not None else 0
+    print(f"Received {num_batches} batches in consumer {rank}.")
+
+
+def create_batch_queue_and_shuffle(
+        filenames: Sequence[str],
+        num_epochs: int,
+        num_trainers: int,
+        batch_size: int,
+        max_concurrent_epochs: int,
+        num_reducers: Optional[int] = None,
+        max_batch_queue_size: int = 0,
+        seed: int = 0,
+        num_workers: Optional[int] = None,
+        queue_name: str = MULTIQUEUE_NAME):
+    """Driver-mode helper: create the queue and start the shuffle before any
+    trainer exists, so every rank can be a pure consumer
+    (reference: dataset.py:17-51)."""
+    batch_queue = mq.MultiQueue(
+        num_epochs * num_trainers, max_batch_queue_size, name=queue_name)
+    batch_queue.size(0)  # liveness probe kept for parity (dataset.py:106)
+    if num_reducers is None:
+        num_reducers = default_num_reducers(num_trainers)
+    logger.info(
+        "Starting shuffle: %d files, %d epochs, %d reducers, %d trainers",
+        len(filenames), num_epochs, num_reducers, num_trainers)
+    shuffle_result = sh.run_shuffle_in_background(
+        filenames,
+        functools.partial(batch_consumer, batch_queue, num_trainers),
+        num_epochs,
+        num_reducers,
+        num_trainers,
+        max_concurrent_epochs,
+        seed=seed,
+        num_workers=num_workers,
+        collect_stats=False)
+    return batch_queue, shuffle_result
+
+
+class ShufflingDataset:
+    """Iterable dataset of exact-size shuffled batches
+    (reference: dataset.py:53-210).
+
+    Rank 0 creates the named queue and kicks off shuffling for up to
+    ``max_concurrent_epochs`` epochs at construction; other ranks connect to
+    the queue by name. Alternatively pass ``batch_queue=``/
+    ``shuffle_result=`` from :func:`create_batch_queue_and_shuffle` and all
+    ranks are pure consumers (the pattern the distributed trainer example
+    uses, reference: dataset.py:84-85,133-135).
+
+    Call :meth:`set_epoch` before each epoch's iteration; the iterator
+    yields pyarrow Tables of exactly ``batch_size`` rows (final partial
+    batch included unless ``drop_last``).
+    """
+
+    def __init__(self,
+                 filenames: Sequence[str],
+                 num_epochs: int,
+                 num_trainers: int,
+                 batch_size: int,
+                 rank: int,
+                 drop_last: bool = False,
+                 num_reducers: Optional[int] = None,
+                 max_concurrent_epochs: int = 2,
+                 batch_queue: Optional[mq.MultiQueue] = None,
+                 shuffle_result: Optional[ex.TaskRef] = None,
+                 max_batch_queue_size: int = 0,
+                 seed: int = 0,
+                 num_workers: Optional[int] = None,
+                 queue_name: str = MULTIQUEUE_NAME):
+        if num_reducers is None:
+            num_reducers = default_num_reducers(num_trainers)
+        self._batch_size = batch_size
+
+        self._owns_queue = False
+        if batch_queue is None:
+            if rank == 0:
+                self._batch_queue, self._shuffle_result = (
+                    create_batch_queue_and_shuffle(
+                        filenames, num_epochs, num_trainers, batch_size,
+                        max_concurrent_epochs, num_reducers,
+                        max_batch_queue_size, seed=seed,
+                        num_workers=num_workers, queue_name=queue_name))
+                self._owns_queue = True
+            else:
+                self._batch_queue = mq.MultiQueue(
+                    0, name=queue_name, connect=True)
+                self._shuffle_result = None
+        else:
+            self._batch_queue = batch_queue
+            self._shuffle_result = shuffle_result
+
+        self._num_epochs = num_epochs
+        self._num_trainers = num_trainers
+        self._rank = rank
+        self._epoch: Optional[int] = None
+        # Guards against iterating without a fresh set_epoch
+        # (reference: dataset.py:143-168).
+        self._last_epoch: Optional[int] = None
+        self._drop_last = drop_last
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch_size
+
+    def set_epoch(self, epoch: int) -> None:
+        """Declare the epoch about to be iterated. Must be called before
+        each epoch's iteration (reference: dataset.py:147-157)."""
+        self._epoch = epoch
+
+    def __iter__(self) -> Iterator[pa.Table]:
+        if self._epoch is None or self._epoch == self._last_epoch:
+            raise ValueError(
+                "You must set the epoch on this dataset via set_epoch() at "
+                "the beginning of each epoch, before iterating over this "
+                "dataset (e.g. via enumerate(ds)).")
+
+        batch_size = self._batch_size
+        queue_idx = self._epoch * self._num_trainers + self._rank
+        # Leftover carry buffer: tables whose total rows < batch_size
+        # (reference keeps a DataFrame buffer, dataset.py:170-202; we keep a
+        # list of zero-copy table slices and concat only when yielding).
+        carry: List[pa.Table] = []
+        carry_rows = 0
+        while True:
+            ref = self._batch_queue.get(queue_idx, block=True)
+            if ref is None:
+                break
+            table: pa.Table = ref.result()
+            offset = 0
+            num_rows = table.num_rows
+            # Top up the carry buffer to a full batch first.
+            if carry_rows:
+                need = batch_size - carry_rows
+                take = min(need, num_rows)
+                carry.append(table.slice(0, take))
+                carry_rows += take
+                offset = take
+                if carry_rows == batch_size:
+                    yield pa.concat_tables(carry)
+                    carry = []
+                    carry_rows = 0
+            # Yield full batches straight out of this table, zero-copy.
+            while num_rows - offset >= batch_size:
+                yield table.slice(offset, batch_size)
+                offset += batch_size
+            # Stash the tail.
+            if offset < num_rows:
+                carry.append(table.slice(offset))
+                carry_rows += num_rows - offset
+        if carry_rows and not self._drop_last:
+            yield pa.concat_tables(carry)
+        self._last_epoch = self._epoch
+        if (self._epoch == self._num_epochs - 1
+                and self._shuffle_result is not None):
+            # Join the shuffle driver (reference: dataset.py:208-210), then
+            # release the queue's name so a later trial in the same process
+            # can reuse it.
+            self._shuffle_result.result()
+            self.shutdown()
+
+    def shutdown(self) -> None:
+        """Release the named queue if this dataset created it. Idempotent.
+
+        The reference leaks its named actor until process exit; we free the
+        name so back-to-back trials in one process work.
+        """
+        if self._owns_queue:
+            self._batch_queue.shutdown()
+            self._owns_queue = False

@@ -1,0 +1,141 @@
+"""Futures-based task executor with ``wait(num_returns)`` semantics.
+
+The reference schedules its map/reduce fan-out on Ray's raylet (C++ external
+dependency) and throttles with ``ray.wait`` (reference: shuffle.py:126-131,
+148-151). On a TPU-VM there is no cluster scheduler between the loader and
+the host: map/reduce tasks are CPU work on the local host (pyarrow releases
+the GIL for Parquet decode and take), so the idiomatic equivalent is a
+thread-pool executor per host plus an explicit ``wait`` that reproduces
+``ray.wait``'s contract — return when ``num_returns`` of the given futures
+have completed, preserving submission order in the done list.
+
+Multi-host scaling composes above this: each host of a TPU slice runs its own
+executor over its shard of the files (SPMD, see parallel/), so no cross-host
+task scheduler is needed — the one piece of Ray's C++ core that survives as
+an idea is plasma's ref-counted buffers, which live in native/.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import os
+import threading
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+
+class TaskRef:
+    """A handle to an in-flight task's result.
+
+    Plays the role of a Ray ObjectRef for loader code: created by
+    :meth:`Executor.submit`, resolved by :func:`get`, waited on by
+    :func:`wait`. Holds a strong reference to the result until dropped,
+    which is what gives the shuffle's throttle loop its memory-release
+    semantics (dropping refs frees buffers, reference: shuffle.py:131-132).
+    """
+
+    __slots__ = ("_future",)
+
+    def __init__(self, future: cf.Future):
+        self._future = future
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        return self._future.result(timeout)
+
+    def done(self) -> bool:
+        return self._future.done()
+
+    def cancel(self) -> bool:
+        return self._future.cancel()
+
+
+def get(refs, timeout: Optional[float] = None):
+    """Resolve a TaskRef or list of TaskRefs to values (ray.get parity)."""
+    if isinstance(refs, TaskRef):
+        return refs.result(timeout)
+    return [r.result(timeout) for r in refs]
+
+
+def wait(refs: Sequence[TaskRef],
+         num_returns: int = 1,
+         timeout: Optional[float] = None) -> Tuple[List[TaskRef], List[TaskRef]]:
+    """Block until ``num_returns`` of ``refs`` are done (ray.wait parity).
+
+    Returns ``(done, not_done)`` with ``done`` ordered by completion
+    readiness scan order (stable w.r.t. input order, like ray.wait).
+    If fewer than ``num_returns`` complete before ``timeout``, returns
+    whatever is done — the caller must not assume ``len(done) ==
+    num_returns`` (the reference's throttle miscounts exactly this way,
+    SURVEY.md §7 "known bugs"; we return the true count).
+    """
+    if num_returns > len(refs):
+        raise ValueError(
+            f"num_returns={num_returns} exceeds number of refs={len(refs)}")
+    if len({id(r) for r in refs}) != len(refs):
+        raise ValueError("wait() does not accept duplicate refs")
+    import time
+    deadline = None if timeout is None else time.monotonic() + timeout
+    pending = {r._future: r for r in refs}
+    done_refs: List[TaskRef] = []
+    satisfied: set = set()
+    while num_returns > 0 and len(satisfied) < num_returns:
+        remaining = [f for f in pending if f not in satisfied]
+        budget = (None if deadline is None
+                  else max(0.0, deadline - time.monotonic()))
+        finished, _ = cf.wait(
+            remaining, timeout=budget,
+            return_when=cf.ALL_COMPLETED
+            if num_returns - len(satisfied) == len(remaining)
+            else cf.FIRST_COMPLETED)
+        satisfied.update(finished)
+        if deadline is not None and time.monotonic() >= deadline:
+            break
+    for ref in refs:  # stable order
+        if ref._future in satisfied and len(done_refs) < max(num_returns, 0):
+            done_refs.append(ref)
+    done_set = set(id(r) for r in done_refs)
+    not_done = [r for r in refs if id(r) not in done_set]
+    return done_refs, not_done
+
+
+class Executor:
+    """Per-host thread-pool task executor.
+
+    Threads (not processes) because the hot work — pyarrow Parquet decode,
+    take/concat, NumPy RNG — releases the GIL; threads share the host RAM
+    arrow buffers zero-copy, which is the plasma-equivalent data plane.
+    """
+
+    def __init__(self, num_workers: Optional[int] = None,
+                 thread_name_prefix: str = "rsdl-worker"):
+        if num_workers is None:
+            num_workers = os.cpu_count() or 4
+        self._num_workers = num_workers
+        self._pool = cf.ThreadPoolExecutor(
+            max_workers=num_workers, thread_name_prefix=thread_name_prefix)
+        self._shutdown = False
+
+    @property
+    def num_workers(self) -> int:
+        return self._num_workers
+
+    def submit(self, fn: Callable, *args, **kwargs) -> TaskRef:
+        if self._shutdown:
+            raise RuntimeError("executor is shut down")
+        return TaskRef(self._pool.submit(fn, *args, **kwargs))
+
+    def map(self, fn: Callable, items: Sequence) -> List[TaskRef]:
+        return [self.submit(fn, item) for item in items]
+
+    def shutdown(self, wait_for_tasks: bool = True) -> None:
+        self._shutdown = True
+        self._pool.shutdown(wait=wait_for_tasks)
+
+    def __enter__(self) -> "Executor":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -1,0 +1,236 @@
+"""Multi-queue batch transport.
+
+Capability parity with the reference's ``MultiQueue`` — N FIFO queues behind
+one named endpoint with sync/async, blocking/non-blocking, and batched
+put/get, named discovery with exponential backoff, and graceful shutdown
+(reference: multiqueue.py:24-307,310-390).
+
+TPU-native design difference: the reference needs a Ray *actor* because its
+trainer processes are spawned by Horovod with no handle to driver state —
+the queue is their rendezvous point (SURVEY.md §1). On a TPU slice, one
+process per host drives all local devices (SPMD), so queues are host-local
+and shared between the shuffle service threads and the training thread in
+the same process. The named registry (process-local) keeps the reference's
+connect-by-name contract so consumer code is identical in both topologies;
+cross-host consumers are not needed because each host shuffles and consumes
+its own shard of the data (deterministic shard routing, SURVEY.md §2.3).
+
+Queue-id contract (unchanged from the reference, dataset.py:173):
+``queue_id = epoch * num_trainers + rank``.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures as cf
+import queue as _queue
+import threading
+import time
+from typing import Any, List, Optional
+
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+
+class Empty(Exception):
+    """Raised by non-blocking gets on an empty queue (reference: multiqueue.py:13-14)."""
+
+
+class Full(Exception):
+    """Raised by non-blocking puts on a full queue (reference: multiqueue.py:17-18)."""
+
+
+# Process-local named-queue registry (stands in for Ray's named actors).
+_REGISTRY: dict = {}
+_REGISTRY_LOCK = threading.Lock()
+
+# Default connect/backoff schedule (reference: multiqueue.py:310-332).
+CONNECT_RETRIES = 5
+CONNECT_INITIAL_BACKOFF_S = 1.0
+
+
+class MultiQueue:
+    """N bounded FIFO queues behind one (optionally named) endpoint.
+
+    ``maxsize=0`` means unbounded — the reference's default in practice
+    (reference: dataset.py:86). ``connect=True`` attaches to an existing
+    named queue instead of creating one.
+    """
+
+    def __init__(self,
+                 num_queues: int,
+                 maxsize: int = 0,
+                 name: Optional[str] = None,
+                 connect: bool = False,
+                 connect_retries: int = CONNECT_RETRIES):
+        if connect:
+            if name is None:
+                raise ValueError("connect=True requires a name")
+            peer = connect_queue(name, retries=connect_retries)
+            # Share the peer's underlying queues.
+            self._queues = peer._queues
+            self._num_queues = peer._num_queues
+            self._maxsize = peer._maxsize
+            self._name = name
+            self._shutdown_event = peer._shutdown_event
+            self._async_pool = peer._async_pool
+            self._inflight_async = peer._inflight_async
+            self._inflight_lock = peer._inflight_lock
+            return
+        if num_queues < 1:
+            raise ValueError(f"num_queues must be >= 1, got {num_queues}")
+        self._num_queues = num_queues
+        self._maxsize = maxsize
+        self._queues: List[_queue.Queue] = [
+            _queue.Queue(maxsize=maxsize) for _ in range(num_queues)
+        ]
+        self._name = name
+        self._shutdown_event = threading.Event()
+        self._async_pool = cf.ThreadPoolExecutor(
+            max_workers=2, thread_name_prefix="rsdl-queue-async")
+        self._inflight_async: set = set()
+        self._inflight_lock = threading.Lock()
+        if name is not None:
+            with _REGISTRY_LOCK:
+                if name in _REGISTRY:
+                    raise ValueError(f"queue name already registered: {name}")
+                _REGISTRY[name] = self
+
+    # -- introspection ------------------------------------------------------
+
+    @property
+    def num_queues(self) -> int:
+        return self._num_queues
+
+    def size(self, queue_index: int) -> int:
+        """Approximate number of items in queue ``queue_index``.
+
+        Also the liveness probe: the reference blocks on ``.size(0)`` to
+        wait for the actor to come up (reference: dataset.py:106).
+        """
+        return self._queues[queue_index].qsize()
+
+    def _check_open(self) -> None:
+        if self._shutdown_event.is_set():
+            raise RuntimeError(f"MultiQueue {self._name!r} is shut down")
+
+    # -- puts ---------------------------------------------------------------
+
+    def put(self, queue_index: int, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        """Put one item (reference: multiqueue.py:98-125)."""
+        self._check_open()
+        try:
+            self._queues[queue_index].put(item, block=block, timeout=timeout)
+        except _queue.Full:
+            raise Full(f"queue {queue_index} is full")
+
+    def put_nowait(self, queue_index: int, item: Any) -> None:
+        self.put(queue_index, item, block=False)
+
+    def put_batch(self, queue_index: int, items: List[Any],
+                  block: bool = True, timeout: Optional[float] = None) -> None:
+        """Put many items FIFO (reference: multiqueue.py:127-154)."""
+        self._check_open()
+        for item in items:
+            self.put(queue_index, item, block=block, timeout=timeout)
+
+    def put_nowait_batch(self, queue_index: int, items: List[Any]) -> None:
+        """All-or-nothing non-blocking batch put, atomic under concurrent
+        producers (reference: multiqueue.py:374-381)."""
+        self._check_open()
+        q = self._queues[queue_index]
+        with q.mutex:
+            if self._maxsize and len(items) > self._maxsize - q._qsize():
+                raise Full(
+                    f"queue {queue_index} cannot accept {len(items)} items "
+                    f"(capacity {self._maxsize}, size {q._qsize()})")
+            q.queue.extend(items)
+            q.unfinished_tasks += len(items)
+            q.not_empty.notify_all()
+
+    def _submit_async(self, fn, *args) -> cf.Future:
+        fut = self._async_pool.submit(fn, *args)
+        with self._inflight_lock:
+            self._inflight_async.add(fut)
+        fut.add_done_callback(
+            lambda f: self._inflight_async.discard(f))
+        return fut
+
+    def put_async(self, queue_index: int, item: Any) -> cf.Future:
+        """Async put; resolves when enqueued (reference: multiqueue.py's *_async)."""
+        self._check_open()
+        return self._submit_async(self.put, queue_index, item)
+
+    # -- gets ---------------------------------------------------------------
+
+    def get(self, queue_index: int, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        """Pop one item (reference: multiqueue.py:185-214)."""
+        try:
+            return self._queues[queue_index].get(block=block, timeout=timeout)
+        except _queue.Empty:
+            raise Empty(f"queue {queue_index} is empty")
+
+    def get_nowait(self, queue_index: int) -> Any:
+        return self.get(queue_index, block=False)
+
+    def get_nowait_batch(self, queue_index: int, num_items: int) -> List[Any]:
+        """Pop exactly ``num_items`` without blocking or raise Empty
+        (all-or-nothing, atomic under concurrent consumers,
+        reference: multiqueue.py:270-283,383-390)."""
+        q = self._queues[queue_index]
+        with q.mutex:
+            if q._qsize() < num_items:
+                raise Empty(
+                    f"queue {queue_index} has {q._qsize()} items, "
+                    f"need {num_items}")
+            items = [q.queue.popleft() for _ in range(num_items)]
+            q.not_full.notify_all()
+        return items
+
+    def get_async(self, queue_index: int) -> cf.Future:
+        """Async blocking get; resolves with the item."""
+        return self._submit_async(self.get, queue_index)
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def shutdown(self, force: bool = False, grace_period_s: float = 5.0) -> None:
+        """Stop accepting puts, drop the name, release async workers.
+
+        The graceful-then-forceful contract of the reference's actor kill
+        (reference: multiqueue.py:285-307) maps to: refuse new puts
+        immediately, wait up to ``grace_period_s`` for in-flight async ops,
+        then cancel whatever remains. Items already enqueued stay readable.
+        """
+        self._shutdown_event.set()
+        if self._name is not None:
+            with _REGISTRY_LOCK:
+                _REGISTRY.pop(self._name, None)
+        if not force:
+            with self._inflight_lock:
+                inflight = list(self._inflight_async)
+            if inflight:
+                cf.wait(inflight, timeout=grace_period_s)
+        self._async_pool.shutdown(wait=False, cancel_futures=True)
+
+
+def connect_queue(name: str,
+                  retries: int = CONNECT_RETRIES,
+                  initial_backoff_s: float = CONNECT_INITIAL_BACKOFF_S
+                  ) -> "MultiQueue":
+    """Look up a named queue with retry + doubling backoff
+    (reference: multiqueue.py:310-332)."""
+    backoff = initial_backoff_s
+    for attempt in range(retries + 1):
+        with _REGISTRY_LOCK:
+            q = _REGISTRY.get(name)
+        if q is not None:
+            return q
+        if attempt == retries:
+            break
+        logger.info("queue %r not found, retrying in %.1fs", name, backoff)
+        time.sleep(backoff)
+        backoff *= 2
+    raise TimeoutError(
+        f"could not connect to queue {name!r} after {retries} retries")

@@ -24,6 +24,10 @@ _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 _load_lock = threading.Lock()
 
+# Fixed so fill_random_* output is host-independent for a given seed; the
+# per-thread stream layout is a function of this value, not of cpu_count.
+_DEFAULT_FILL_THREADS = 8
+
 
 def _build() -> bool:
     cmd = [
@@ -109,6 +113,14 @@ def partition_indices(assignments: np.ndarray,
         raise ValueError(f"num_reducers must be >= 1, got {num_reducers}")
     lib = _load()
     assert lib is not None
+    assignments = np.asarray(assignments)
+    if assignments.dtype != np.uint32:
+        # Guard the lossy cast: values that would wrap modulo 2**32 must
+        # raise like the NumPy fallback does, not silently mis-partition.
+        if assignments.size and (assignments.min() < 0
+                                 or assignments.max() >= 2**32):
+            raise ValueError(
+                f"assignment value out of range for num_reducers={num_reducers}")
     assignments = np.ascontiguousarray(assignments, dtype=np.uint32)
     n = len(assignments)
     out = np.empty(n, dtype=np.int64)
@@ -125,13 +137,18 @@ def partition_indices(assignments: np.ndarray,
 
 def fill_random_int64(n: int, bound: int, seed: int,
                       nthreads: int = 0) -> np.ndarray:
-    """Threaded uniform int64 fill in [0, bound)."""
+    """Threaded uniform int64 fill in [0, bound).
+
+    Output depends on (seed, nthreads) only — the default nthreads is a
+    fixed constant (not cpu_count) so the same seed reproduces the same
+    data on any host.
+    """
     if bound < 1:
         raise ValueError(f"bound must be >= 1, got {bound}")
     lib = _load()
     assert lib is not None
     if nthreads <= 0:
-        nthreads = min(8, os.cpu_count() or 1)
+        nthreads = _DEFAULT_FILL_THREADS
     out = np.empty(n, dtype=np.int64)
     lib.rsdl_fill_random_int64(
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n, bound,
@@ -140,11 +157,12 @@ def fill_random_int64(n: int, bound: int, seed: int,
 
 
 def fill_random_double(n: int, seed: int, nthreads: int = 0) -> np.ndarray:
-    """Threaded uniform double fill in [0, 1)."""
+    """Threaded uniform double fill in [0, 1). Same (seed, nthreads)
+    determinism contract as :func:`fill_random_int64`."""
     lib = _load()
     assert lib is not None
     if nthreads <= 0:
-        nthreads = min(8, os.cpu_count() or 1)
+        nthreads = _DEFAULT_FILL_THREADS
     out = np.empty(n, dtype=np.float64)
     lib.rsdl_fill_random_double(
         out.ctypes.data_as(ctypes.POINTER(ctypes.c_double)), n,

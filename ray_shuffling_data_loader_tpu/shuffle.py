@@ -1,0 +1,296 @@
+"""Per-epoch map/reduce shuffle engine with epoch pipelining.
+
+Capability parity with the reference's shuffle engine (reference:
+shuffle.py:21-263): per epoch, one map task per Parquet file uniformly
+scatters its rows across ``num_reducers`` reducers; one reduce task per
+reducer concatenates its chunks from every file and permutes them; reducer
+outputs are routed round-robin-contiguously to trainers via a
+``batch_consumer`` callback followed by a ``None`` sentinel; shuffles for up
+to ``max_concurrent_epochs`` epochs run concurrently with consumption,
+throttled so host memory stays bounded.
+
+TPU-native design differences:
+
+- Tasks are host threads on the TPU-VM (executor.py), not Ray tasks; data
+  is pyarrow Tables, not pandas DataFrames — Arrow's C++ kernels (Parquet
+  decode, take, concat) release the GIL and its buffers are the zero-copy
+  data plane that plasma provided externally (SURVEY.md §2.3).
+- Map->reduce dependencies resolve by submission order: per epoch all maps
+  are submitted before any reduce, and the FIFO thread pool guarantees a
+  blocked reduce only ever waits on maps that already hold or preceded its
+  worker slot, so the pattern is deadlock-free at any pool size.
+- Every random draw is keyed by (seed, epoch, task) — the reference's
+  unseeded ``np.random.randint`` / ``df.sample`` (reference: shuffle.py:213,
+  240) made epochs irreproducible; ours replay bit-identically, which is
+  what makes loader checkpoint/resume possible.
+- The reference's reduce bug that turns a 1-row batch into a column lookup
+  (``if len(batch) == 1: batch = batch[0]``, reference: shuffle.py:241-242)
+  is intentionally not replicated.
+"""
+
+from __future__ import annotations
+
+import timeit
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ray_shuffling_data_loader_tpu import executor as ex
+from ray_shuffling_data_loader_tpu import stats as stats_mod
+from ray_shuffling_data_loader_tpu.ops import partition as ops
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+# batch_consumer(rank, epoch, refs_or_None) — refs are TaskRefs resolving to
+# pyarrow Tables (reference passes ObjectRefs of DataFrames,
+# reference: dataset.py:213-224).
+BatchConsumer = Callable[[int, int, Optional[Sequence[ex.TaskRef]]], None]
+
+
+def shuffle_map(filename: str,
+                num_reducers: int,
+                seed: int,
+                epoch: int,
+                file_index: int,
+                stats_collector=None) -> List[pa.Table]:
+    """Read one file and scatter its rows into per-reducer tables
+    (reference: shuffle.py:199-226)."""
+    if stats_collector is not None:
+        stats_collector.map_start(epoch)
+    start = timeit.default_timer()
+    table = pq.read_table(filename)
+    end_read = timeit.default_timer()
+    rng = ops.map_rng(seed, epoch, file_index)
+    assignments = ops.assign_reducers(table.num_rows, num_reducers, rng)
+    index_parts = ops.partition_indices(assignments, num_reducers)
+    parts = [table.take(idx) for idx in index_parts]
+    if stats_collector is not None:
+        stats_collector.map_done(epoch, timeit.default_timer() - start,
+                                 end_read - start)
+    return parts
+
+
+def shuffle_reduce(reduce_index: int,
+                   seed: int,
+                   epoch: int,
+                   chunks: Sequence[pa.Table],
+                   stats_collector=None) -> pa.Table:
+    """Concatenate one chunk per file and permute the rows
+    (reference: shuffle.py:229-247)."""
+    if stats_collector is not None:
+        stats_collector.reduce_start(epoch)
+    start = timeit.default_timer()
+    table = pa.concat_tables(chunks)
+    perm = ops.permutation(table.num_rows,
+                           ops.reduce_rng(seed, epoch, reduce_index))
+    shuffled = table.take(perm)
+    if stats_collector is not None:
+        stats_collector.reduce_done(epoch, timeit.default_timer() - start)
+    return shuffled
+
+
+def _reduce_task(reduce_index: int, seed: int, epoch: int,
+                 map_refs: Sequence[ex.TaskRef], stats_collector) -> pa.Table:
+    """Executor wrapper: resolve this reducer's chunk from every map output.
+
+    Equivalent of Ray resolving ``shuffle_reduce.remote(*refs)`` argument
+    refs (reference: shuffle.py:182-187) — but we fetch only column slice
+    ``reduce_index`` of each map result, zero-copy.
+    """
+    chunks = [ref.result()[reduce_index] for ref in map_refs]
+    return shuffle_reduce(reduce_index, seed, epoch, chunks, stats_collector)
+
+
+def consume(trainer_idx: int,
+            batch_consumer: BatchConsumer,
+            trial_start: float,
+            stats_collector,
+            epoch: int,
+            batches: List[ex.TaskRef]) -> None:
+    """Hand one trainer its epoch's reducer refs (reference: shuffle.py:250-263)."""
+    if stats_collector is not None:
+        stats_collector.consume_start(epoch)
+    start = timeit.default_timer()
+    trial_time_to_consume = start - trial_start
+    batch_consumer(trainer_idx, epoch, batches)
+    if stats_collector is not None:
+        stats_collector.consume_done(epoch, timeit.default_timer() - start,
+                                     trial_time_to_consume)
+
+
+def shuffle_epoch(epoch: int,
+                  filenames: Sequence[str],
+                  batch_consumer: BatchConsumer,
+                  num_reducers: int,
+                  num_trainers: int,
+                  pool: ex.Executor,
+                  seed: int,
+                  trial_start: float,
+                  stats_collector=None) -> List[ex.TaskRef]:
+    """Launch one epoch's map/reduce and route outputs to trainers
+    (reference: shuffle.py:163-196). Returns the reducer TaskRefs."""
+    if stats_collector is not None:
+        stats_collector.epoch_start(epoch)
+    map_refs = [
+        pool.submit(shuffle_map, filename, num_reducers, seed, epoch,
+                    file_index, stats_collector)
+        for file_index, filename in enumerate(filenames)
+    ]
+    reduce_refs = [
+        pool.submit(_reduce_task, reduce_index, seed, epoch, map_refs,
+                    stats_collector)
+        for reduce_index in range(num_reducers)
+    ]
+    for trainer_idx, batches in enumerate(
+            ops.contiguous_splits(reduce_refs, num_trainers)):
+        consume(trainer_idx, batch_consumer, trial_start, stats_collector,
+                epoch, batches)
+        # Epoch-end sentinel per trainer (reference: shuffle.py:195).
+        batch_consumer(trainer_idx, epoch, None)
+    return reduce_refs
+
+
+def shuffle(filenames: Sequence[str],
+            batch_consumer: BatchConsumer,
+            num_epochs: int,
+            num_reducers: int,
+            num_trainers: int,
+            max_concurrent_epochs: int = 2,
+            seed: int = 0,
+            num_workers: Optional[int] = None,
+            collect_stats: bool = True,
+            pool: Optional[ex.Executor] = None
+            ) -> Union[stats_mod.TrialStats, float]:
+    """Multi-epoch pipelined shuffle driver (reference: shuffle.py:79-160).
+
+    Keeps at most ``max_concurrent_epochs`` epochs' shuffles in flight:
+    before launching epoch E, blocks on the oldest incomplete epoch's
+    reducers and then drops their refs so Arrow buffers already consumed
+    by trainers can be freed (reference: shuffle.py:103-140).
+
+    Returns ``TrialStats`` when ``collect_stats`` else the wall-clock
+    duration in seconds (reference: shuffle.py:155-160).
+    """
+    stats_collector = None
+    if collect_stats:
+        stats_collector = stats_mod.TrialStatsCollector(
+            num_epochs, num_maps=len(filenames), num_reduces=num_reducers,
+            num_consumes=num_trainers)
+        stats_collector.trial_start()
+    start = timeit.default_timer()
+
+    owns_pool = pool is None
+    if pool is None:
+        pool = ex.Executor(num_workers=num_workers)
+    try:
+        in_progress: Dict[int, List[ex.TaskRef]] = {}
+        for epoch_idx in range(num_epochs):
+            throttle_start = timeit.default_timer()
+            while len(in_progress) >= max_concurrent_epochs:
+                oldest_epoch = min(in_progress)
+                refs = in_progress.pop(oldest_epoch)
+                ex.wait(refs, num_returns=len(refs))
+                for ref in refs:
+                    ref.result()  # propagate map/reduce failures (instant)
+                # Refs dropped here -> reducer Tables release once trainers
+                # finish with them (reference: shuffle.py:131-132).
+            throttle_duration = timeit.default_timer() - throttle_start
+            if stats_collector is not None and throttle_duration > 1e-4:
+                stats_collector.throttle_done(epoch_idx, throttle_duration)
+            if throttle_duration > 1e-4:
+                logger.info("epoch %d throttled for %.3fs", epoch_idx,
+                            throttle_duration)
+            in_progress[epoch_idx] = shuffle_epoch(
+                epoch_idx, filenames, batch_consumer, num_reducers,
+                num_trainers, pool, seed, start, stats_collector)
+        # Final drain: wait for all remaining reducer tasks
+        # (reference: shuffle.py:148-151).
+        for epoch_idx in sorted(in_progress):
+            refs = in_progress.pop(epoch_idx)
+            ex.wait(refs, num_returns=len(refs))
+            for ref in refs:
+                ref.result()  # propagate map/reduce failures (instant)
+    finally:
+        if owns_pool:
+            pool.shutdown()
+
+    if stats_collector is not None:
+        stats_collector.trial_done()
+        return stats_collector.get_stats()
+    return timeit.default_timer() - start
+
+
+def shuffle_with_stats(
+        filenames: Sequence[str],
+        batch_consumer: BatchConsumer,
+        num_epochs: int,
+        num_reducers: int,
+        num_trainers: int,
+        max_concurrent_epochs: int = 2,
+        seed: int = 0,
+        num_workers: Optional[int] = None,
+        utilization_sample_period: float = 5.0
+) -> Tuple[stats_mod.TrialStats, List]:
+    """Shuffle plus a concurrent memory-utilization sampler thread
+    (reference: shuffle.py:21-55)."""
+    store_stats: List = []
+    done_event = stats_mod.start_store_stats_sampler(
+        store_stats, sample_period_s=utilization_sample_period)
+    try:
+        trial_stats = shuffle(filenames, batch_consumer, num_epochs,
+                              num_reducers, num_trainers,
+                              max_concurrent_epochs, seed=seed,
+                              num_workers=num_workers, collect_stats=True)
+    finally:
+        done_event.set()
+    return trial_stats, store_stats
+
+
+def shuffle_no_stats(filenames: Sequence[str],
+                     batch_consumer: BatchConsumer,
+                     num_epochs: int,
+                     num_reducers: int,
+                     num_trainers: int,
+                     max_concurrent_epochs: int = 2,
+                     seed: int = 0,
+                     num_workers: Optional[int] = None
+                     ) -> Tuple[float, List]:
+    """Duration-only variant (reference: shuffle.py:58-76)."""
+    duration = shuffle(filenames, batch_consumer, num_epochs, num_reducers,
+                       num_trainers, max_concurrent_epochs, seed=seed,
+                       num_workers=num_workers, collect_stats=False)
+    return duration, []
+
+
+def run_shuffle_in_background(
+        filenames: Sequence[str],
+        batch_consumer: BatchConsumer,
+        num_epochs: int,
+        num_reducers: int,
+        num_trainers: int,
+        max_concurrent_epochs: int = 2,
+        seed: int = 0,
+        num_workers: Optional[int] = None,
+        collect_stats: bool = False) -> ex.TaskRef:
+    """Launch the whole multi-epoch shuffle as one background task.
+
+    Stands in for the reference driver's ``ray.remote(shuffle).remote(...)``
+    (reference: dataset.py:110-118): the returned TaskRef is the
+    ``shuffle_result`` handle the dataset joins after the last epoch.
+    """
+    # A dedicated single-worker executor hosts the driver loop so it never
+    # competes with map/reduce workers for a pool slot.
+    driver_pool = ex.Executor(num_workers=1, thread_name_prefix="rsdl-driver")
+
+    def _run():
+        try:
+            return shuffle(filenames, batch_consumer, num_epochs,
+                           num_reducers, num_trainers, max_concurrent_epochs,
+                           seed=seed, num_workers=num_workers,
+                           collect_stats=collect_stats)
+        finally:
+            driver_pool.shutdown(wait_for_tasks=False)
+
+    return driver_pool.submit(_run)

@@ -1,0 +1,578 @@
+"""Stats / observability subsystem.
+
+Capability parity with the reference's stats pipeline (reference:
+stats.py:22-60 dataclass model, :68-200 epoch collector, :202-253 trial
+collector, :255-574 CSV report writers, :580-648 humanizers + object-store
+sampler), re-based on threads instead of Ray actors: collectors are
+thread-safe in-process objects (the shuffle's map/reduce/consume tasks are
+host threads here, so a lock replaces the actor mailbox).
+
+Report schema: the trial CSV and epoch CSV column sets reproduce the
+reference's exactly (reference: stats.py:305-355,468-505) so downstream
+tooling reads either. Memory utilization sampling replaces the raylet gRPC
+store probe (reference: stats.py:598-632) with host RSS + native buffer-pool
+bytes + optional TPU HBM via ``device.memory_stats()``.
+"""
+
+from __future__ import annotations
+
+import csv
+import datetime
+import os
+import threading
+import time
+import timeit
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ray_shuffling_data_loader_tpu.utils.humanize import (
+    human_readable_big_num, human_readable_size)
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+
+# ---------------------------------------------------------------------------
+# Stats model (reference: stats.py:22-60)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class StageStats:
+    task_durations: List[float]
+    stage_duration: float
+
+
+@dataclass
+class MapStats(StageStats):
+    read_durations: List[float]
+
+
+@dataclass
+class ReduceStats(StageStats):
+    pass
+
+
+@dataclass
+class ConsumeStats(StageStats):
+    consume_times: List[float]
+
+
+@dataclass
+class ThrottleStats:
+    wait_duration: float
+
+
+@dataclass
+class EpochStats:
+    duration: float
+    map_stats: MapStats
+    reduce_stats: ReduceStats
+    consume_stats: ConsumeStats
+    throttle_stats: ThrottleStats
+
+
+@dataclass
+class TrialStats:
+    epoch_stats: List[EpochStats]
+    duration: float
+
+
+@dataclass
+class MemorySample:
+    """One utilization sample (replaces the raylet object-store sample)."""
+    timestamp: float
+    rss_bytes: int
+    pool_bytes: int
+    hbm_bytes: int = 0
+
+    @property
+    def object_store_bytes_used(self) -> int:
+        """Reference-compatible accessor (reference: stats.py:266)."""
+        return self.pool_bytes if self.pool_bytes else self.rss_bytes
+
+
+# ---------------------------------------------------------------------------
+# Collectors (reference: stats.py:68-253, actors -> thread-safe objects)
+# ---------------------------------------------------------------------------
+
+
+class EpochStatsCollector:
+    """Per-epoch stage-span collector with first-start/last-done edge
+    detection (reference: stats.py:68-200)."""
+
+    def __init__(self, num_maps: int, num_reduces: int, num_consumes: int):
+        self._num_maps = num_maps
+        self._num_reduces = num_reduces
+        self._num_consumes = num_consumes
+        self._lock = threading.Lock()
+        self._epoch_start_time: Optional[float] = None
+        self._duration: Optional[float] = None
+        self._maps_started = 0
+        self._maps_done = 0
+        self._map_durations: List[float] = []
+        self._read_durations: List[float] = []
+        self._reduces_started = 0
+        self._reduces_done = 0
+        self._reduce_durations: List[float] = []
+        self._consumes_started = 0
+        self._consumes_done = 0
+        self._consume_durations: List[float] = []
+        self._consume_times: List[float] = []
+        self._throttle_duration = 0.0
+        self._stage_start: Dict[str, Optional[float]] = {
+            "map": None, "reduce": None, "consume": None}
+        self._stage_duration: Dict[str, Optional[float]] = {
+            "map": None, "reduce": None, "consume": None}
+        self._done_event = threading.Event()
+
+    def epoch_start(self) -> None:
+        with self._lock:
+            self._epoch_start_time = timeit.default_timer()
+
+    def map_start(self) -> None:
+        self._stage_task_start("map")
+
+    def map_done(self, duration: float, read_duration: float) -> None:
+        with self._lock:
+            self._maps_done += 1
+            self._map_durations.append(duration)
+            self._read_durations.append(read_duration)
+            if self._maps_done == self._num_maps:
+                self._stage_done_locked("map")
+
+    def reduce_start(self) -> None:
+        self._stage_task_start("reduce")
+
+    def reduce_done(self, duration: float) -> None:
+        with self._lock:
+            self._reduces_done += 1
+            self._reduce_durations.append(duration)
+            if self._reduces_done == self._num_reduces:
+                self._stage_done_locked("reduce")
+                # Epoch "shuffle done" edge = last reduce done
+                # (reference: stats.py:152-156).
+                assert self._epoch_start_time is not None
+                self._duration = (timeit.default_timer()
+                                  - self._epoch_start_time)
+                self._done_event.set()
+
+    def consume_start(self) -> None:
+        self._stage_task_start("consume")
+
+    def consume_done(self, duration: float,
+                     trial_time_to_consume: float) -> None:
+        with self._lock:
+            self._consumes_done += 1
+            self._consume_durations.append(duration)
+            self._consume_times.append(trial_time_to_consume)
+            if self._consumes_done == self._num_consumes:
+                self._stage_done_locked("consume")
+
+    def throttle_done(self, duration: float) -> None:
+        with self._lock:
+            self._throttle_duration += duration
+
+    def _stage_task_start(self, stage: str) -> None:
+        with self._lock:
+            counter = {"map": "_maps_started", "reduce": "_reduces_started",
+                       "consume": "_consumes_started"}[stage]
+            if getattr(self, counter) == 0:
+                self._stage_start[stage] = timeit.default_timer()
+            setattr(self, counter, getattr(self, counter) + 1)
+
+    def _stage_done_locked(self, stage: str) -> None:
+        start = self._stage_start[stage]
+        assert start is not None, f"{stage} stage never started"
+        self._stage_duration[stage] = timeit.default_timer() - start
+
+    def wait_until_done(self, timeout: Optional[float] = None) -> bool:
+        return self._done_event.wait(timeout)
+
+    def get_stats(self) -> EpochStats:
+        with self._lock:
+            assert self._maps_done == self._num_maps, (
+                f"epoch incomplete: {self._maps_done}/{self._num_maps} maps")
+            assert self._reduces_done == self._num_reduces, (
+                f"epoch incomplete: {self._reduces_done}/{self._num_reduces}"
+                " reduces")
+            return EpochStats(
+                duration=self._duration or 0.0,
+                map_stats=MapStats(list(self._map_durations),
+                                   self._stage_duration["map"] or 0.0,
+                                   list(self._read_durations)),
+                reduce_stats=ReduceStats(list(self._reduce_durations),
+                                         self._stage_duration["reduce"] or 0.0),
+                consume_stats=ConsumeStats(list(self._consume_durations),
+                                           self._stage_duration["consume"]
+                                           or 0.0,
+                                           list(self._consume_times)),
+                throttle_stats=ThrottleStats(self._throttle_duration))
+
+
+class TrialStatsCollector:
+    """Whole-trial collector: one EpochStatsCollector per epoch plus trial
+    wall-clock (reference: stats.py:202-253)."""
+
+    def __init__(self, num_epochs: int, num_maps: int, num_reduces: int,
+                 num_consumes: int):
+        self._num_epochs = num_epochs
+        self._epochs = [
+            EpochStatsCollector(num_maps, num_reduces, num_consumes)
+            for _ in range(num_epochs)
+        ]
+        self._trial_start_time: Optional[float] = None
+        self._trial_duration: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def trial_start(self) -> None:
+        with self._lock:
+            self._trial_start_time = timeit.default_timer()
+
+    @property
+    def trial_start_time(self) -> float:
+        assert self._trial_start_time is not None
+        return self._trial_start_time
+
+    def epoch(self, epoch: int) -> EpochStatsCollector:
+        return self._epochs[epoch]
+
+    # Per-task hooks mirroring the reference actor's method surface
+    # (reference: shuffle.py:204-263 call sites).
+    def epoch_start(self, epoch: int) -> None:
+        self._epochs[epoch].epoch_start()
+
+    def map_start(self, epoch: int) -> None:
+        self._epochs[epoch].map_start()
+
+    def map_done(self, epoch: int, duration: float,
+                 read_duration: float) -> None:
+        self._epochs[epoch].map_done(duration, read_duration)
+
+    def reduce_start(self, epoch: int) -> None:
+        self._epochs[epoch].reduce_start()
+
+    def reduce_done(self, epoch: int, duration: float) -> None:
+        self._epochs[epoch].reduce_done(duration)
+
+    def consume_start(self, epoch: int) -> None:
+        self._epochs[epoch].consume_start()
+
+    def consume_done(self, epoch: int, duration: float,
+                     trial_time_to_consume: float) -> None:
+        self._epochs[epoch].consume_done(duration, trial_time_to_consume)
+
+    def throttle_done(self, epoch: int, duration: float) -> None:
+        self._epochs[epoch].throttle_done(duration)
+
+    def trial_done(self) -> None:
+        with self._lock:
+            assert self._trial_start_time is not None
+            self._trial_duration = (timeit.default_timer()
+                                    - self._trial_start_time)
+
+    def get_stats(self, timeout: Optional[float] = None) -> TrialStats:
+        for collector in self._epochs:
+            collector.wait_until_done(timeout)
+        with self._lock:
+            duration = self._trial_duration
+        if duration is None:
+            assert self._trial_start_time is not None
+            duration = timeit.default_timer() - self._trial_start_time
+        return TrialStats(
+            epoch_stats=[c.get_stats() for c in self._epochs],
+            duration=duration)
+
+
+# ---------------------------------------------------------------------------
+# Batch-wait tracking (the north-star stall metric,
+# reference: examples/horovod/ray_torch_shuffle.py:186-218)
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class BatchWaitStats:
+    wait_times: List[float] = field(default_factory=list)
+
+    def record(self, wait_s: float) -> None:
+        self.wait_times.append(wait_s)
+
+    def summary(self) -> Dict[str, float]:
+        if not self.wait_times:
+            return {"mean": 0.0, "std": 0.0, "max": 0.0, "min": 0.0,
+                    "total": 0.0, "count": 0}
+        arr = np.asarray(self.wait_times)
+        return {
+            "mean": float(arr.mean()), "std": float(arr.std()),
+            "max": float(arr.max()), "min": float(arr.min()),
+            "total": float(arr.sum()), "count": int(len(arr)),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Memory utilization sampler (reference: stats.py:598-648, raylet gRPC ->
+# host/pool/HBM introspection)
+# ---------------------------------------------------------------------------
+
+
+def _read_rss_bytes() -> int:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+def get_memory_stats(sample_hbm: bool = False) -> MemorySample:
+    """One utilization sample: process RSS, native pool bytes, optional HBM."""
+    from ray_shuffling_data_loader_tpu import native
+    pool_bytes = 0
+    if native.available():
+        pool_bytes = native.NativeBufferPool().bytes_in_use()
+    hbm = 0
+    if sample_hbm:
+        try:
+            import jax
+            for dev in jax.local_devices():
+                stats = dev.memory_stats()
+                if stats:
+                    hbm += stats.get("bytes_in_use", 0)
+        except Exception:  # noqa: BLE001 - sampling must never kill a trial
+            hbm = 0
+    return MemorySample(timestamp=time.time(), rss_bytes=_read_rss_bytes(),
+                        pool_bytes=pool_bytes, hbm_bytes=hbm)
+
+
+def collect_store_stats(stats_list: List[Tuple[float, MemorySample]],
+                        done_event: threading.Event,
+                        sample_period_s: float = 5.0,
+                        sample_hbm: bool = False) -> None:
+    """Sampler loop body: append (timestamp, sample) until done_event is set
+    (reference: stats.py:635-648). Run it in a daemon thread."""
+    while not done_event.is_set():
+        sample = get_memory_stats(sample_hbm=sample_hbm)
+        stats_list.append((sample.timestamp, sample))
+        done_event.wait(sample_period_s)
+
+
+def start_store_stats_sampler(
+        stats_list: List[Tuple[float, MemorySample]],
+        sample_period_s: float = 5.0,
+        sample_hbm: bool = False) -> threading.Event:
+    """Spawn the sampler thread; returns the event that stops it
+    (reference: shuffle.py:32-37 thread wiring)."""
+    done = threading.Event()
+    thread = threading.Thread(
+        target=collect_store_stats,
+        args=(stats_list, done, sample_period_s, sample_hbm),
+        daemon=True, name="rsdl-store-stats")
+    thread.start()
+    return done
+
+
+# ---------------------------------------------------------------------------
+# CSV report writers (reference: stats.py:255-574; identical column sets)
+# ---------------------------------------------------------------------------
+
+
+def _spread(prefix: str, values: List[float]) -> Dict[str, float]:
+    arr = np.asarray(values) if values else np.asarray([0.0])
+    return {
+        f"avg_{prefix}": float(arr.mean()),
+        f"std_{prefix}": float(arr.std()),
+        f"max_{prefix}": float(arr.max()),
+        f"min_{prefix}": float(arr.min()),
+    }
+
+
+TRIAL_FIELDNAMES = [
+    "num_files", "num_row_groups_per_file", "num_reducers", "num_trainers",
+    "num_epochs", "max_concurrent_epochs", "trial", "duration",
+    "row_throughput", "batch_throughput", "batch_throughput_per_trainer",
+    "avg_object_store_utilization", "max_object_store_utilization",
+    "avg_epoch_duration", "std_epoch_duration", "max_epoch_duration",
+    "min_epoch_duration",
+    "avg_map_stage_duration", "std_map_stage_duration",
+    "max_map_stage_duration", "min_map_stage_duration",
+    "avg_reduce_stage_duration", "std_reduce_stage_duration",
+    "max_reduce_stage_duration", "min_reduce_stage_duration",
+    "avg_consume_stage_duration", "std_consume_stage_duration",
+    "max_consume_stage_duration", "min_consume_stage_duration",
+    "avg_map_task_duration", "std_map_task_duration",
+    "max_map_task_duration", "min_map_task_duration",
+    "avg_read_duration", "std_read_duration", "max_read_duration",
+    "min_read_duration",
+    "avg_reduce_task_duration", "std_reduce_task_duration",
+    "max_reduce_task_duration", "min_reduce_task_duration",
+    "avg_consume_task_duration", "std_consume_task_duration",
+    "max_consume_task_duration", "min_consume_task_duration",
+    "avg_time_to_consume", "std_time_to_consume", "max_time_to_consume",
+    "min_time_to_consume",
+]
+
+EPOCH_FIELDNAMES = [
+    "num_files", "num_row_groups_per_file", "num_reducers", "num_trainers",
+    "num_epochs", "max_concurrent_epochs", "trial", "epoch", "duration",
+    "row_throughput", "batch_throughput", "batch_throughput_per_trainer",
+    "map_stage_duration", "reduce_stage_duration", "consume_stage_duration",
+    "avg_map_task_duration", "std_map_task_duration",
+    "max_map_task_duration", "min_map_task_duration",
+    "avg_read_duration", "std_read_duration", "max_read_duration",
+    "min_read_duration",
+    "avg_reduce_task_duration", "std_reduce_task_duration",
+    "max_reduce_task_duration", "min_reduce_task_duration",
+    "avg_consume_task_duration", "std_consume_task_duration",
+    "max_consume_task_duration", "min_consume_task_duration",
+    "avg_time_to_consume", "std_time_to_consume", "max_time_to_consume",
+    "min_time_to_consume",
+]
+
+
+def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySample]]]],
+                  overwrite_stats: bool,
+                  stats_dir: str,
+                  no_epoch_stats: bool,
+                  unique_stats: bool,
+                  num_rows: int,
+                  num_files: int,
+                  num_row_groups_per_file: int,
+                  batch_size: int,
+                  num_reducers: int,
+                  num_trainers: int,
+                  num_epochs: int,
+                  max_concurrent_epochs: int) -> None:
+    """Write trial + epoch CSVs and print the summary
+    (reference: stats.py:255-574; same signature, same columns)."""
+    os.makedirs(stats_dir, exist_ok=True)
+    stats_list = [s for s, _ in all_stats]
+    store_stats_list = [ss for _, ss in all_stats]
+    times = [s.duration for s in stats_list]
+    mean, std = float(np.mean(times)), float(np.std(times))
+    all_samples = [sample.object_store_bytes_used
+                   for trial_ss in store_stats_list
+                   for _, sample in trial_ss]
+    num_samples = len(all_samples)
+    max_util = human_readable_size(max(all_samples)) if all_samples else "0 B"
+    throughput_std = float(np.std(
+        [num_epochs * num_rows / t for t in times]))
+    batch_tp_std = float(np.std(
+        [(num_epochs * num_rows / batch_size) / t for t in times]))
+    print(f"\nMean over {len(times)} trials: {mean:.3f}s +- {std}")
+    print(f"Mean throughput over {len(times)} trials: "
+          f"{num_epochs * num_rows / mean:.2f} rows/s +- {throughput_std:.2f}")
+    print(f"Mean batch throughput over {len(times)} trials: "
+          f"{(num_epochs * num_rows / batch_size) / mean:.2f} batches/s +- "
+          f"{batch_tp_std:.2f}")
+    print(f"Max memory utilization over {num_samples} samples: {max_util}\n")
+
+    write_mode = "w+" if overwrite_stats else "a+"
+    hr_rows = human_readable_big_num(num_rows)
+    hr_batch = human_readable_big_num(batch_size)
+    now = datetime.datetime.now(datetime.timezone.utc).isoformat()
+
+    def _open_report(kind: str):
+        filename = f"{kind}_stats_{hr_rows}_rows_{hr_batch}_batch_size"
+        filename += f"_{now}.csv" if unique_stats else ".csv"
+        path = os.path.join(stats_dir, filename)
+        header = (overwrite_stats or not os.path.exists(path)
+                  or os.path.getsize(path) == 0)
+        return path, header
+
+    static = {
+        "num_files": num_files,
+        "num_row_groups_per_file": num_row_groups_per_file,
+        "num_reducers": num_reducers,
+        "num_trainers": num_trainers,
+        "num_epochs": num_epochs,
+        "max_concurrent_epochs": max_concurrent_epochs,
+    }
+
+    path, header = _open_report("trial")
+    logger.info("Writing trial stats to %s", path)
+    with open(path, write_mode) as f:
+        writer = csv.DictWriter(f, fieldnames=TRIAL_FIELDNAMES)
+        if header:
+            writer.writeheader()
+        for trial, (stats, trial_ss) in enumerate(all_stats):
+            row: Dict[str, Any] = dict(static)
+            row["trial"] = trial
+            row["duration"] = stats.duration
+            row_tp = num_epochs * num_rows / stats.duration
+            row["row_throughput"] = row_tp
+            row["batch_throughput"] = row_tp / batch_size
+            row["batch_throughput_per_trainer"] = (
+                row_tp / batch_size / num_trainers)
+            samples = [s.object_store_bytes_used for _, s in trial_ss]
+            row["avg_object_store_utilization"] = (
+                float(np.mean(samples)) if samples else 0.0)
+            row["max_object_store_utilization"] = (
+                float(np.max(samples)) if samples else 0.0)
+            row.update(_spread("epoch_duration",
+                               [e.duration for e in stats.epoch_stats]))
+            row.update(_spread(
+                "map_stage_duration",
+                [e.map_stats.stage_duration for e in stats.epoch_stats]))
+            row.update(_spread(
+                "reduce_stage_duration",
+                [e.reduce_stats.stage_duration for e in stats.epoch_stats]))
+            row.update(_spread(
+                "consume_stage_duration",
+                [e.consume_stats.stage_duration for e in stats.epoch_stats]))
+            row.update(_spread(
+                "map_task_duration",
+                [d for e in stats.epoch_stats
+                 for d in e.map_stats.task_durations]))
+            row.update(_spread(
+                "read_duration",
+                [d for e in stats.epoch_stats
+                 for d in e.map_stats.read_durations]))
+            row.update(_spread(
+                "reduce_task_duration",
+                [d for e in stats.epoch_stats
+                 for d in e.reduce_stats.task_durations]))
+            row.update(_spread(
+                "consume_task_duration",
+                [d for e in stats.epoch_stats
+                 for d in e.consume_stats.task_durations]))
+            row.update(_spread(
+                "time_to_consume",
+                [d for e in stats.epoch_stats
+                 for d in e.consume_stats.consume_times]))
+            writer.writerow(row)
+
+    if no_epoch_stats:
+        return
+    path, header = _open_report("epoch")
+    logger.info("Writing epoch stats to %s", path)
+    with open(path, write_mode) as f:
+        writer = csv.DictWriter(f, fieldnames=EPOCH_FIELDNAMES)
+        if header:
+            writer.writeheader()
+        for trial, (stats, _) in enumerate(all_stats):
+            for epoch, e in enumerate(stats.epoch_stats):
+                row = dict(static)
+                row["trial"] = trial
+                row["epoch"] = epoch
+                row["duration"] = e.duration
+                row_tp = num_rows / e.duration if e.duration else 0.0
+                row["row_throughput"] = row_tp
+                row["batch_throughput"] = row_tp / batch_size
+                row["batch_throughput_per_trainer"] = (
+                    row_tp / batch_size / num_trainers)
+                row["map_stage_duration"] = e.map_stats.stage_duration
+                row["reduce_stage_duration"] = e.reduce_stats.stage_duration
+                row["consume_stage_duration"] = (
+                    e.consume_stats.stage_duration)
+                row.update(_spread("map_task_duration",
+                                   e.map_stats.task_durations))
+                row.update(_spread("read_duration",
+                                   e.map_stats.read_durations))
+                row.update(_spread("reduce_task_duration",
+                                   e.reduce_stats.task_durations))
+                row.update(_spread("consume_task_duration",
+                                   e.consume_stats.task_durations))
+                row.update(_spread("time_to_consume",
+                                   e.consume_stats.consume_times))
+                writer.writerow(row)

@@ -1,0 +1,201 @@
+"""Tests for the ShufflingDataset iterator (dataset.py)."""
+
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ray_shuffling_data_loader_tpu import dataset as ds
+from ray_shuffling_data_loader_tpu import executor as ex
+from ray_shuffling_data_loader_tpu import multiqueue as mq
+
+
+def write_files(tmp_path, num_files=4, rows_per_file=100):
+    filenames = []
+    for i in range(num_files):
+        start = i * rows_per_file
+        table = pa.table({
+            "key": pa.array(range(start, start + rows_per_file),
+                            type=pa.int64()),
+            "feat": pa.array(
+                np.arange(start, start + rows_per_file, dtype=np.float64)),
+        })
+        path = str(tmp_path / f"input_{i}.parquet")
+        pq.write_table(table, path)
+        filenames.append(path)
+    return filenames
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    # Each test gets a clean named-queue registry.
+    mq._REGISTRY.clear()
+    yield
+    mq._REGISTRY.clear()
+
+
+def make_ref(pool, table):
+    return pool.submit(lambda t=table: t)
+
+
+def feed_queue(pool, queue, queue_idx, tables):
+    for t in tables:
+        queue.put(queue_idx, make_ref(pool, t))
+    queue.put(queue_idx, None)
+
+
+def make_table(start, n):
+    return pa.table({"key": pa.array(range(start, start + n),
+                                     type=pa.int64())})
+
+
+def manual_dataset(pool, tables, batch_size, drop_last=False,
+                   num_epochs=1, num_trainers=1, rank=0):
+    queue = mq.MultiQueue(num_epochs * num_trainers)
+    d = ds.ShufflingDataset(
+        filenames=[], num_epochs=num_epochs, num_trainers=num_trainers,
+        batch_size=batch_size, rank=rank, drop_last=drop_last,
+        batch_queue=queue, shuffle_result=None)
+    feed_queue(pool, queue, rank, tables)
+    return d
+
+
+def test_exact_rebatching_across_reducer_boundaries():
+    with ex.Executor(2) as pool:
+        # Reducer outputs of ragged sizes 7, 3, 12, 5 = 27 rows; batch 6.
+        tables = [make_table(0, 7), make_table(7, 3), make_table(10, 12),
+                  make_table(22, 5)]
+        d = manual_dataset(pool, tables, batch_size=6)
+        d.set_epoch(0)
+        batches = list(d)
+    sizes = [b.num_rows for b in batches]
+    assert sizes == [6, 6, 6, 6, 3]  # exact batches + partial tail
+    # Order is preserved and nothing is lost or duplicated.
+    keys = [k for b in batches for k in b.column("key").to_pylist()]
+    assert keys == list(range(27))
+
+
+def test_drop_last():
+    with ex.Executor(2) as pool:
+        d = manual_dataset(pool, [make_table(0, 10)], batch_size=4,
+                           drop_last=True)
+        d.set_epoch(0)
+        sizes = [b.num_rows for b in d]
+    assert sizes == [4, 4]  # trailing 2 rows dropped
+
+
+def test_batch_exactly_divides():
+    with ex.Executor(2) as pool:
+        d = manual_dataset(pool, [make_table(0, 8), make_table(8, 8)],
+                           batch_size=4)
+        d.set_epoch(0)
+        sizes = [b.num_rows for b in d]
+    assert sizes == [4, 4, 4, 4]
+
+
+def test_tiny_reducer_outputs_accumulate():
+    with ex.Executor(2) as pool:
+        # Many 1-row tables; batch 5.
+        tables = [make_table(i, 1) for i in range(12)]
+        d = manual_dataset(pool, tables, batch_size=5)
+        d.set_epoch(0)
+        batches = list(d)
+    assert [b.num_rows for b in batches] == [5, 5, 2]
+    keys = [k for b in batches for k in b.column("key").to_pylist()]
+    assert keys == list(range(12))
+
+
+def test_set_epoch_guard():
+    with ex.Executor(2) as pool:
+        queue = mq.MultiQueue(2)
+        d = ds.ShufflingDataset(filenames=[], num_epochs=2, num_trainers=1,
+                                batch_size=4, rank=0, batch_queue=queue)
+        with pytest.raises(ValueError):
+            iter(d).__next__()  # no set_epoch
+        feed_queue(pool, queue, 0, [make_table(0, 4)])
+        d.set_epoch(0)
+        assert [b.num_rows for b in d] == [4]
+        with pytest.raises(ValueError):
+            iter(d).__next__()  # same epoch twice without set_epoch
+        feed_queue(pool, queue, 1, [make_table(0, 4)])
+        d.set_epoch(1)
+        assert [b.num_rows for b in d] == [4]
+
+
+def test_end_to_end_rank0_creates_pipeline(tmp_path):
+    filenames = write_files(tmp_path, num_files=3, rows_per_file=64)
+    d = ds.ShufflingDataset(
+        filenames, num_epochs=2, num_trainers=1, batch_size=16, rank=0,
+        num_reducers=4, seed=5, queue_name="e2e-test-queue")
+    all_keys = []
+    for epoch in range(2):
+        d.set_epoch(epoch)
+        keys = []
+        for batch in d:
+            assert batch.num_rows == 16
+            keys.extend(batch.column("key").to_pylist())
+        assert sorted(keys) == list(range(192)), f"epoch {epoch}"
+        all_keys.append(keys)
+    assert all_keys[0] != all_keys[1]  # epochs are differently shuffled
+
+
+def test_end_to_end_two_trainer_threads(tmp_path):
+    """Rank 0 creates the pipeline; rank 1 connects by name; together they
+    see every key exactly once per epoch."""
+    filenames = write_files(tmp_path, num_files=4, rows_per_file=50)
+    num_epochs, num_trainers, batch_size = 2, 2, 10
+    results = {}
+    errors = []
+    barrier = threading.Barrier(num_trainers)
+
+    def trainer(rank):
+        try:
+            if rank != 0:
+                barrier.wait(timeout=30)  # let rank 0 create the queue
+            d = ds.ShufflingDataset(
+                filenames, num_epochs=num_epochs, num_trainers=num_trainers,
+                batch_size=batch_size, rank=rank, num_reducers=4, seed=1,
+                queue_name="two-trainer-queue")
+            if rank == 0:
+                barrier.wait(timeout=30)
+            per_epoch = []
+            for epoch in range(num_epochs):
+                d.set_epoch(epoch)
+                per_epoch.append(
+                    [k for b in d for k in b.column("key").to_pylist()])
+            results[rank] = per_epoch
+        except Exception as e:  # noqa: BLE001
+            errors.append((rank, e))
+
+    threads = [threading.Thread(target=trainer, args=(r,))
+               for r in range(num_trainers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors, errors
+    for epoch in range(num_epochs):
+        combined = results[0][epoch] + results[1][epoch]
+        assert sorted(combined) == list(range(200)), f"epoch {epoch}"
+
+
+def test_debug_batch_consumer(capsys):
+    ds.debug_batch_consumer(0, 0, None)
+    ds.debug_batch_consumer(1, 0, [1, 2, 3])
+    out = capsys.readouterr().out
+    assert "Received 0 batches in consumer 0." in out
+    assert "Received 3 batches in consumer 1." in out
+
+
+def test_sequential_trials_reuse_default_queue_name(tmp_path):
+    """Two back-to-back datasets with the same queue name must not collide
+    (regression: the named queue used to leak in the registry)."""
+    filenames = write_files(tmp_path, num_files=2, rows_per_file=20)
+    for trial in range(2):
+        d = ds.ShufflingDataset(filenames, num_epochs=1, num_trainers=1,
+                                batch_size=10, rank=0, num_reducers=2,
+                                seed=trial)
+        d.set_epoch(0)
+        assert sum(b.num_rows for b in d) == 40

@@ -1,0 +1,77 @@
+"""Tests for the futures executor (executor.py)."""
+
+import threading
+import time
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import executor as ex
+
+
+def test_submit_and_get():
+    with ex.Executor(num_workers=2) as pool:
+        ref = pool.submit(lambda x: x * 2, 21)
+        assert ex.get(ref) == 42
+        refs = pool.map(lambda x: x + 1, [1, 2, 3])
+        assert ex.get(refs) == [2, 3, 4]
+
+
+def test_wait_num_returns():
+    with ex.Executor(num_workers=4) as pool:
+        gate = threading.Event()
+        fast = [pool.submit(lambda i=i: i) for i in range(3)]
+        slow = pool.submit(lambda: (gate.wait(5), "slow")[1])
+        done, not_done = ex.wait(fast + [slow], num_returns=3)
+        assert len(done) == 3
+        assert slow in not_done
+        gate.set()
+        done, not_done = ex.wait([slow], num_returns=1)
+        assert done == [slow] and not_done == []
+
+
+def test_wait_all():
+    with ex.Executor(num_workers=4) as pool:
+        refs = [pool.submit(time.sleep, 0.01) for _ in range(5)]
+        done, not_done = ex.wait(refs, num_returns=5)
+        assert len(done) == 5 and not not_done
+
+
+def test_wait_timeout_returns_true_count():
+    with ex.Executor(num_workers=2) as pool:
+        gate = threading.Event()
+        blocked = [pool.submit(gate.wait, 5) for _ in range(2)]
+        t0 = time.monotonic()
+        done, not_done = ex.wait(blocked, num_returns=2, timeout=0.1)
+        assert time.monotonic() - t0 < 2.0
+        # The reference's throttle assumes len(done) == num_returns even on
+        # timeout (SURVEY.md §7 known bugs); we report the truth.
+        assert len(done) == 0 and len(not_done) == 2
+        gate.set()
+
+
+def test_wait_num_returns_too_large():
+    with ex.Executor(num_workers=1) as pool:
+        refs = [pool.submit(lambda: 1)]
+        with pytest.raises(ValueError):
+            ex.wait(refs, num_returns=2)
+
+
+def test_task_exception_propagates():
+    with ex.Executor(num_workers=1) as pool:
+        ref = pool.submit(lambda: 1 / 0)
+        with pytest.raises(ZeroDivisionError):
+            ex.get(ref)
+
+
+def test_submit_after_shutdown_raises():
+    pool = ex.Executor(num_workers=1)
+    pool.shutdown()
+    with pytest.raises(RuntimeError):
+        pool.submit(lambda: 1)
+
+
+def test_wait_preserves_input_order():
+    with ex.Executor(num_workers=4) as pool:
+        refs = [pool.submit(time.sleep, 0.05 - 0.01 * i) for i in range(4)]
+        done, _ = ex.wait(refs, num_returns=4)
+        assert done == refs  # stable w.r.t. input order

@@ -1,0 +1,194 @@
+"""Tests for the multi-queue batch transport (multiqueue.py)."""
+
+import threading
+import time
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import multiqueue as mq
+
+
+def make_queue(**kw):
+    return mq.MultiQueue(num_queues=4, **kw)
+
+
+def test_fifo_per_queue():
+    q = make_queue()
+    q.put(0, "a")
+    q.put(0, "b")
+    q.put(1, "c")
+    assert q.get(0) == "a"
+    assert q.get(0) == "b"
+    assert q.get(1) == "c"
+
+
+def test_get_nowait_empty_raises():
+    q = make_queue()
+    with pytest.raises(mq.Empty):
+        q.get_nowait(2)
+
+
+def test_put_nowait_full_raises():
+    q = mq.MultiQueue(num_queues=1, maxsize=2)
+    q.put_nowait(0, 1)
+    q.put_nowait(0, 2)
+    with pytest.raises(mq.Full):
+        q.put_nowait(0, 3)
+
+
+def test_put_batch_and_get_nowait_batch():
+    q = make_queue()
+    q.put_batch(0, [1, 2, 3, 4])
+    assert q.get_nowait_batch(0, 3) == [1, 2, 3]
+    with pytest.raises(mq.Empty):
+        q.get_nowait_batch(0, 2)  # only 1 left — all-or-nothing
+    assert q.get_nowait_batch(0, 1) == [4]
+
+
+def test_put_nowait_batch_all_or_nothing():
+    q = mq.MultiQueue(num_queues=1, maxsize=3)
+    q.put_nowait(0, 0)
+    with pytest.raises(mq.Full):
+        q.put_nowait_batch(0, [1, 2, 3])  # 3 > remaining capacity 2
+    assert q.size(0) == 1  # nothing was enqueued
+    q.put_nowait_batch(0, [1, 2])
+    assert q.size(0) == 3
+
+
+def test_blocking_get_wakes_on_put():
+    q = make_queue()
+    result = []
+
+    def consumer():
+        result.append(q.get(3, block=True, timeout=5))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.05)
+    q.put(3, "wake")
+    t.join(timeout=5)
+    assert result == ["wake"]
+
+
+def test_bounded_queue_backpressure():
+    q = mq.MultiQueue(num_queues=1, maxsize=1)
+    q.put(0, "x")
+    t0 = time.monotonic()
+
+    def slow_consumer():
+        time.sleep(0.1)
+        q.get(0)
+
+    t = threading.Thread(target=slow_consumer)
+    t.start()
+    q.put(0, "y", block=True, timeout=5)  # blocks until consumer frees a slot
+    assert time.monotonic() - t0 >= 0.09
+    t.join()
+
+
+def test_named_registry_connect():
+    q = make_queue(name="test-queue-connect")
+    try:
+        peer = mq.MultiQueue(num_queues=0, name="test-queue-connect",
+                             connect=True)
+        q.put(2, "via-owner")
+        assert peer.get(2) == "via-owner"
+        assert peer.num_queues == 4
+    finally:
+        q.shutdown()
+
+
+def test_connect_missing_times_out_with_backoff():
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        mq.connect_queue("no-such-queue", retries=2, initial_backoff_s=0.05)
+    # Two backoffs: 0.05 + 0.1.
+    assert time.monotonic() - t0 >= 0.15
+
+
+def test_connect_succeeds_after_delay():
+    def creator():
+        time.sleep(0.1)
+        make_queue(name="late-queue")
+
+    t = threading.Thread(target=creator)
+    t.start()
+    q = mq.connect_queue("late-queue", retries=5, initial_backoff_s=0.05)
+    t.join()
+    try:
+        assert q.num_queues == 4
+    finally:
+        q.shutdown()
+
+
+def test_duplicate_name_raises():
+    q = make_queue(name="dup-queue")
+    try:
+        with pytest.raises(ValueError):
+            make_queue(name="dup-queue")
+    finally:
+        q.shutdown()
+
+
+def test_shutdown_refuses_puts_allows_drain():
+    q = make_queue(name="shutdown-queue")
+    q.put(0, "pre")
+    q.shutdown()
+    with pytest.raises(RuntimeError):
+        q.put(0, "post")
+    # Already-enqueued items remain readable.
+    assert q.get(0) == "pre"
+    # Name is released.
+    with pytest.raises(TimeoutError):
+        mq.connect_queue("shutdown-queue", retries=0)
+
+
+def test_async_put_get():
+    q = make_queue()
+    fut = q.put_async(1, "async-item")
+    fut.result(timeout=5)
+    gfut = q.get_async(1)
+    assert gfut.result(timeout=5) == "async-item"
+    q.shutdown()
+
+
+def test_queue_id_contract():
+    # queue_id = epoch * num_trainers + rank (reference: dataset.py:173)
+    num_trainers, num_epochs = 3, 2
+    q = mq.MultiQueue(num_queues=num_epochs * num_trainers)
+    for epoch in range(num_epochs):
+        for rank in range(num_trainers):
+            q.put(epoch * num_trainers + rank, (epoch, rank))
+    for epoch in range(num_epochs):
+        for rank in range(num_trainers):
+            assert q.get(epoch * num_trainers + rank) == (epoch, rank)
+
+
+def test_get_nowait_batch_atomic_under_concurrency():
+    q = mq.MultiQueue(num_queues=1)
+    q.put_batch(0, list(range(100)))
+    got, lock = [], threading.Lock()
+
+    def worker():
+        while True:
+            try:
+                items = q.get_nowait_batch(0, 10)
+            except mq.Empty:
+                return
+            with lock:
+                got.extend(items)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert sorted(got) == list(range(100))  # nothing lost, nothing doubled
+
+
+def test_shutdown_graceful_waits_for_async():
+    q = mq.MultiQueue(num_queues=1)
+    fut = q.put_async(0, "x")
+    q.shutdown(grace_period_s=5.0)
+    assert fut.done() and fut.exception() is None
+    assert q.get(0) == "x"

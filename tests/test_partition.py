@@ -162,3 +162,19 @@ def test_fill_random_bad_bound_raises():
         pytest.skip("native library unavailable")
     with pytest.raises(ValueError):
         native.fill_random_int64(10, 0, seed=1)
+
+
+def test_native_partition_rejects_wrapping_values():
+    if not native.available():
+        pytest.skip("native library unavailable")
+    bad = np.array([2**32], dtype=np.int64)  # would wrap to 0 as uint32
+    with pytest.raises(ValueError):
+        native.partition_indices(bad, 3)
+
+
+def test_wait_duplicate_refs_rejected():
+    from ray_shuffling_data_loader_tpu import executor as ex
+    with ex.Executor(1) as pool:
+        ref = pool.submit(lambda: 1)
+        with pytest.raises(ValueError):
+            ex.wait([ref, ref], num_returns=2)

@@ -1,0 +1,105 @@
+"""Tests for the stats subsystem (stats.py)."""
+
+import csv
+import threading
+import time
+
+from ray_shuffling_data_loader_tpu import stats as st
+
+
+def _fill_trial(collector, num_epochs, num_maps, num_reduces, num_consumes):
+    for e in range(num_epochs):
+        collector.epoch_start(e)
+        for _ in range(num_maps):
+            collector.map_start(e)
+        for _ in range(num_maps):
+            collector.map_done(e, 0.01, 0.005)
+        for _ in range(num_reduces):
+            collector.reduce_start(e)
+        for _ in range(num_reduces):
+            collector.reduce_done(e, 0.02)
+        for _ in range(num_consumes):
+            collector.consume_start(e)
+        for _ in range(num_consumes):
+            collector.consume_done(e, 0.003, 0.1)
+    collector.trial_done()
+
+
+def test_trial_collector_roundtrip():
+    c = st.TrialStatsCollector(num_epochs=2, num_maps=3, num_reduces=2,
+                               num_consumes=2)
+    c.trial_start()
+    _fill_trial(c, 2, 3, 2, 2)
+    stats = c.get_stats(timeout=5)
+    assert stats.duration > 0
+    assert len(stats.epoch_stats) == 2
+    es = stats.epoch_stats[0]
+    assert es.map_stats.task_durations == [0.01] * 3
+    assert es.map_stats.read_durations == [0.005] * 3
+    assert es.reduce_stats.task_durations == [0.02] * 2
+    assert es.consume_stats.consume_times == [0.1] * 2
+
+
+def test_collector_thread_safety():
+    c = st.TrialStatsCollector(num_epochs=1, num_maps=64, num_reduces=0,
+                               num_consumes=0)
+    c.trial_start()
+    c.epoch_start(0)
+    threads = [threading.Thread(target=lambda: (c.map_start(0),
+                                                c.map_done(0, 0.001, 0.0)))
+               for _ in range(64)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    epoch = c.epoch(0)
+    assert epoch._maps_done == 64
+    assert len(epoch._map_durations) == 64
+
+
+def test_batch_wait_stats():
+    w = st.BatchWaitStats()
+    assert w.summary()["count"] == 0
+    for v in (0.1, 0.2, 0.3):
+        w.record(v)
+    s = w.summary()
+    assert abs(s["mean"] - 0.2) < 1e-9
+    assert s["max"] == 0.3 and s["min"] == 0.1 and s["count"] == 3
+
+
+def test_memory_sampler_produces_samples():
+    samples = []
+    done = st.start_store_stats_sampler(samples, sample_period_s=0.01)
+    time.sleep(0.1)
+    done.set()
+    assert len(samples) >= 2
+    ts, sample = samples[0]
+    assert sample.rss_bytes > 0
+    assert sample.object_store_bytes_used > 0
+
+
+def test_process_stats_writes_reference_schema(tmp_path):
+    c = st.TrialStatsCollector(num_epochs=2, num_maps=2, num_reduces=2,
+                               num_consumes=1)
+    c.trial_start()
+    _fill_trial(c, 2, 2, 2, 1)
+    trial_stats = c.get_stats(timeout=5)
+    sample = st.get_memory_stats()
+    st.process_stats(
+        [(trial_stats, [(sample.timestamp, sample)])],
+        overwrite_stats=True, stats_dir=str(tmp_path), no_epoch_stats=False,
+        unique_stats=False, num_rows=1000, num_files=2,
+        num_row_groups_per_file=1, batch_size=100, num_reducers=2,
+        num_trainers=1, num_epochs=2, max_concurrent_epochs=2)
+    trial_csv = list(tmp_path.glob("trial_stats_*.csv"))
+    epoch_csv = list(tmp_path.glob("epoch_stats_*.csv"))
+    assert len(trial_csv) == 1 and len(epoch_csv) == 1
+    with open(trial_csv[0]) as f:
+        rows = list(csv.DictReader(f))
+    assert len(rows) == 1
+    assert list(rows[0].keys()) == st.TRIAL_FIELDNAMES
+    assert float(rows[0]["row_throughput"]) > 0
+    with open(epoch_csv[0]) as f:
+        erows = list(csv.DictReader(f))
+    assert len(erows) == 2
+    assert list(erows[0].keys()) == st.EPOCH_FIELDNAMES
