@@ -1,0 +1,157 @@
+"""Headline benchmark: rows/sec/chip ingested through the full pipeline.
+
+Measures the BASELINE.md primary metric: rows per second streamed from
+shuffled Parquet through the map/reduce shuffle, re-batching, Arrow->NumPy
+conversion, and ``jax.device_put`` onto the accelerator (a tiny jitted
+reduction per batch forces materialization on device, so transfers are not
+imaginary). This is the loader path a real trainer consumes
+(reference harness analog: benchmarks/benchmark.py + the batch-wait metric
+of examples/horovod/ray_torch_shuffle.py:186-218).
+
+``vs_baseline`` compares against the reference's algorithm run the way the
+reference runs it per core — pandas ``read_parquet``, boolean-mask
+partitioning, ``pd.concat`` + ``sample(frac=1)``, sequential single process
+(reference: shuffle.py:199-247) — measured on the same data and host in the
+same run.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Env knobs: RSDL_BENCH_ROWS, RSDL_BENCH_FILES, RSDL_BENCH_EPOCHS,
+RSDL_BENCH_BATCH, RSDL_BENCH_CPU=1 (force CPU backend for smoke runs),
+RSDL_BENCH_DATA (data cache dir).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import timeit
+
+
+def _pandas_reference_baseline(filenames, num_reducers: int,
+                               batch_size: int) -> float:
+    """rows/s of the reference's shuffle algorithm, single process."""
+    import numpy as np
+    import pandas as pd
+
+    start = timeit.default_timer()
+    total_rows = 0
+    # Map stage: read + uniform partition via boolean masks.
+    reducer_parts = [[] for _ in range(num_reducers)]
+    for filename in filenames:
+        rows = pd.read_parquet(filename)
+        total_rows += len(rows)
+        assignment = np.random.randint(num_reducers, size=len(rows))
+        for r in range(num_reducers):
+            reducer_parts[r].append(rows[assignment == r])
+    # Reduce stage: concat + permute.
+    shuffled = [pd.concat(parts).sample(frac=1) for parts in reducer_parts]
+    # Consume: exact-size re-batching with leftover carry.
+    buffer = None
+    for df in shuffled:
+        buffer = df if buffer is None else pd.concat([buffer, df])
+        while len(buffer) >= batch_size:
+            batch = buffer[:batch_size]
+            _ = batch.to_numpy(copy=False)
+            buffer = buffer[batch_size:]
+    duration = timeit.default_timer() - start
+    return total_rows / duration
+
+
+def main() -> None:
+    if os.environ.get("RSDL_BENCH_CPU"):
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=1")
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+    else:
+        import jax
+
+    import numpy as np
+
+    from ray_shuffling_data_loader_tpu import data_generation as datagen
+    from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
+    from ray_shuffling_data_loader_tpu.utils.config import default_num_reducers
+
+    num_rows = int(os.environ.get("RSDL_BENCH_ROWS", 2_000_000))
+    num_files = int(os.environ.get("RSDL_BENCH_FILES", 8))
+    # 4 epochs, first excluded as warm-up: with max_concurrent_epochs=2 the
+    # timed window includes steady-state shuffle work, not just draining
+    # pre-shuffled queues.
+    num_epochs = int(os.environ.get("RSDL_BENCH_EPOCHS", 4))
+    batch_size = int(os.environ.get("RSDL_BENCH_BATCH", 65_536))
+    data_dir = os.environ.get("RSDL_BENCH_DATA", "/tmp/rsdl_bench_data")
+
+    marker = os.path.join(data_dir, f".rows_{num_rows}_files_{num_files}")
+    if not os.path.exists(marker):
+        import glob
+        import shutil
+        if os.path.isdir(data_dir):
+            shutil.rmtree(data_dir)
+        filenames, _ = datagen.generate_data(
+            num_rows, num_files, num_row_groups_per_file=4,
+            max_row_group_skew=0.0, data_dir=data_dir, seed=0)
+        with open(marker, "w") as f:
+            f.write("\n".join(filenames))
+    with open(marker) as f:
+        filenames = f.read().splitlines()
+
+    device = jax.devices()[0]
+    print(f"# bench device: {device}", file=sys.stderr)
+
+    # At least 4 reducers: even on small hosts, finer reducer granularity
+    # pipelines read/partition/permute stages against consumption.
+    num_reducers = max(4, default_num_reducers(num_trainers=1))
+
+    ds = JaxShufflingDataset(
+        filenames, num_epochs=num_epochs, num_trainers=1,
+        batch_size=batch_size, rank=0,
+        feature_columns=list(datagen.FEATURE_COLUMNS),
+        feature_types=[np.int32] * len(datagen.FEATURE_COLUMNS),
+        label_column=datagen.LABEL_COLUMN,
+        num_reducers=num_reducers, max_concurrent_epochs=2, seed=0,
+        queue_name="bench-queue", drop_last=True)
+
+    # Tiny jitted reduction per batch: forces every feature column to land
+    # on device; negligible compute.
+    touch = jax.jit(lambda fs, y: sum(f.sum() for f in fs) + y.sum())
+
+    # Warm-up epoch 0 separately to exclude one-time compile cost (with a
+    # single epoch there is no warm-up and compile time is included).
+    rows_consumed = 0
+    start = timeit.default_timer()
+    last = None
+    for epoch in range(num_epochs):
+        ds.set_epoch(epoch)
+        for features, label in ds:
+            last = touch(features, label)
+            if epoch > 0 or num_epochs == 1:
+                rows_consumed += label.shape[0]
+        if epoch == 0 and num_epochs > 1:
+            jax.block_until_ready(last)
+            start = timeit.default_timer()
+    jax.block_until_ready(last)
+    duration = max(timeit.default_timer() - start, 1e-9)
+    pipeline_rows_per_s = rows_consumed / duration
+
+    baseline_files = filenames[:max(1, len(filenames) // 4)]
+    baseline_rows_per_s = _pandas_reference_baseline(
+        baseline_files, num_reducers=max(2, num_reducers // 4),
+        batch_size=batch_size)
+    print(f"# pipeline: {pipeline_rows_per_s:,.0f} rows/s | "
+          f"pandas reference algo: {baseline_rows_per_s:,.0f} rows/s | "
+          f"stall {ds.batch_wait_stats.summary()['total']:.3f}s over "
+          f"{ds.batch_wait_stats.summary()['count']} batches",
+          file=sys.stderr)
+
+    print(json.dumps({
+        "metric": "shuffle_ingest_rows_per_sec_per_chip",
+        "value": round(pipeline_rows_per_s, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(pipeline_rows_per_s / baseline_rows_per_s, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

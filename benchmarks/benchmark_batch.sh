@@ -1,0 +1,41 @@
+#!/usr/bin/env bash
+# Nested benchmark sweep (reference analog: benchmarks/benchmark_batch.sh —
+# files {100,50,25} x trainers {16,8,4} x reducers-per-trainer {4,3,2} at
+# 4e8 rows / batch 250k / 10 epochs / 2 trials on a 4-node cluster).
+# Host-local scale is set by env so the same script runs on a laptop or a
+# TPU-VM: SWEEP_ROWS (default 4e6), SWEEP_EPOCHS (default 10).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+ROWS="${SWEEP_ROWS:-4000000}"
+EPOCHS="${SWEEP_EPOCHS:-10}"
+BATCH="${SWEEP_BATCH:-250000}"
+TRIALS="${SWEEP_TRIALS:-2}"
+DATA_DIR="${SWEEP_DATA_DIR:-./benchmark_data}"
+STATS_DIR="${SWEEP_STATS_DIR:-./results}"
+
+first=1
+for files in 100 50 25; do
+  for trainers in 16 8 4; do
+    for reducers_per_trainer in 4 3 2; do
+      reducers=$((trainers * reducers_per_trainer))
+      use_old=""
+      if [ "$first" -eq 0 ]; then use_old="--use-old-data"; fi
+      first=0
+      echo "=== files=$files trainers=$trainers reducers=$reducers ==="
+      python benchmarks/benchmark.py \
+        --num-rows "$ROWS" \
+        --num-files "$files" \
+        --num-row-groups-per-file 5 \
+        --num-reducers "$reducers" \
+        --num-trainers "$trainers" \
+        --num-epochs "$EPOCHS" \
+        --batch-size "$BATCH" \
+        --max-concurrent-epochs 2 \
+        --num-trials "$TRIALS" \
+        --data-dir "$DATA_DIR" \
+        --stats-dir "$STATS_DIR" \
+        $use_old
+    done
+  done
+done

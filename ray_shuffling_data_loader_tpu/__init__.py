@@ -14,12 +14,18 @@ __version__ = "0.1.0"
 
 from ray_shuffling_data_loader_tpu.dataset import (  # noqa: E402,F401
     ShufflingDataset, create_batch_queue_and_shuffle)
+from ray_shuffling_data_loader_tpu.jax_dataset import (  # noqa: E402,F401
+    JaxShufflingDataset)
 from ray_shuffling_data_loader_tpu.multiqueue import MultiQueue  # noqa: E402,F401
 from ray_shuffling_data_loader_tpu.shuffle import (  # noqa: E402,F401
     shuffle, shuffle_with_stats, shuffle_no_stats)
 
+# "TorchShufflingDataset" is importable by name via module __getattr__ but
+# intentionally not in __all__: star-import must not require (or eagerly
+# import) the optional torch dependency.
 __all__ = [
     "ShufflingDataset",
+    "JaxShufflingDataset",
     "MultiQueue",
     "shuffle",
     "shuffle_with_stats",
@@ -27,3 +33,14 @@ __all__ = [
     "create_batch_queue_and_shuffle",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    # Lazy: importing torch costs seconds and most TPU users never need the
+    # migration-compat binding (the reference exports it eagerly,
+    # reference: __init__.py:1-11).
+    if name == "TorchShufflingDataset":
+        from ray_shuffling_data_loader_tpu.torch_dataset import (
+            TorchShufflingDataset)
+        return TorchShufflingDataset
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

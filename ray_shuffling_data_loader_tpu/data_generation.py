@@ -1,0 +1,166 @@
+"""Synthetic DLRM-style Parquet data generation.
+
+Capability parity with the reference's generator (reference:
+data_generation.py:14-111): DLRM-like tabular rows — 17 int64 embedding
+columns with fixed cardinalities, 2 small categorical columns, a float64
+label, and a globally-unique monotonically increasing ``key`` — written as
+``input_data_{i}.parquet.snappy`` with a controlled row-group size. The
+``key`` column is what the tests use to prove every row appears exactly
+once per shuffled epoch.
+
+TPU-native differences: files are generated in parallel on the host's
+thread pool (pyarrow's writer releases the GIL) instead of Ray tasks; the
+random fills use the threaded native C++ generator (xoshiro256**) when
+available, seeded per (seed, file) so datasets are reproducible — the
+reference's ``np.random`` is unseeded. ``max_row_group_skew`` is accepted
+but must be 0.0, matching the reference's unimplemented TODO
+(reference: data_generation.py:16-17).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+from ray_shuffling_data_loader_tpu import executor as ex
+from ray_shuffling_data_loader_tpu import native
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+# Column spec: name -> (low, high, dtype)
+# (reference: data_generation.py:74-95).
+DATA_SPEC = {
+    "embeddings_name0": (0, 2385, np.int64),
+    "embeddings_name1": (0, 201, np.int64),
+    "embeddings_name2": (0, 201, np.int64),
+    "embeddings_name3": (0, 6, np.int64),
+    "embeddings_name4": (0, 19, np.int64),
+    "embeddings_name5": (0, 1441, np.int64),
+    "embeddings_name6": (0, 201, np.int64),
+    "embeddings_name7": (0, 22, np.int64),
+    "embeddings_name8": (0, 156, np.int64),
+    "embeddings_name9": (0, 1216, np.int64),
+    "embeddings_name10": (0, 9216, np.int64),
+    "embeddings_name11": (0, 88999, np.int64),
+    "embeddings_name12": (0, 941792, np.int64),
+    "embeddings_name13": (0, 9405, np.int64),
+    "embeddings_name14": (0, 83332, np.int64),
+    "embeddings_name15": (0, 828767, np.int64),
+    "embeddings_name16": (0, 945195, np.int64),
+    "one_hot0": (0, 3, np.int64),
+    "one_hot1": (0, 50, np.int64),
+    "labels": (0, 1, np.float64),
+}
+
+EMBEDDING_COLUMNS = [c for c in DATA_SPEC if c.startswith("embeddings")]
+ONE_HOT_COLUMNS = [c for c in DATA_SPEC if c.startswith("one_hot")]
+FEATURE_COLUMNS = EMBEDDING_COLUMNS + ONE_HOT_COLUMNS
+LABEL_COLUMN = "labels"
+KEY_COLUMN = "key"
+
+
+def _fill_int(n: int, low: int, high: int, seed: int) -> np.ndarray:
+    if native.available():
+        return low + native.fill_random_int64(n, high - low, seed)
+    rng = np.random.Generator(np.random.Philox(np.random.SeedSequence(seed)))
+    return rng.integers(low, high, size=n, dtype=np.int64)
+
+
+def _fill_float(n: int, low: float, high: float, seed: int) -> np.ndarray:
+    if native.available():
+        return low + (high - low) * native.fill_random_double(n, seed)
+    rng = np.random.Generator(np.random.Philox(np.random.SeedSequence(seed)))
+    return low + (high - low) * rng.random(n)
+
+
+def generate_row_group(group_index: int, global_row_index: int,
+                       num_rows_in_group: int,
+                       seed: int = 0) -> pa.Table:
+    """One row group as a pyarrow Table (reference: data_generation.py:98-111)."""
+    columns = {
+        KEY_COLUMN: np.arange(global_row_index,
+                              global_row_index + num_rows_in_group,
+                              dtype=np.int64),
+    }
+    for col_index, (col, (low, high, dtype)) in enumerate(DATA_SPEC.items()):
+        col_seed = (seed * 1_000_003 + global_row_index) * 53 + col_index
+        if np.issubdtype(dtype, np.integer):
+            columns[col] = _fill_int(num_rows_in_group, low, high, col_seed)
+        else:
+            columns[col] = _fill_float(num_rows_in_group, low, high, col_seed)
+    return pa.table(columns)
+
+
+def generate_file(file_index: int, global_row_index: int,
+                  num_rows_in_file: int, num_row_groups_per_file: int,
+                  data_dir: str, seed: int = 0) -> Tuple[str, int]:
+    """Write one Parquet file; returns (path, in-memory byte size)
+    (reference: data_generation.py:48-71)."""
+    rows_per_group = max(1, num_rows_in_file // num_row_groups_per_file)
+    tables = []
+    for group_index, group_start in enumerate(
+            range(0, num_rows_in_file, rows_per_group)):
+        num_rows_in_group = min(rows_per_group,
+                                num_rows_in_file - group_start)
+        tables.append(
+            generate_row_group(group_index, global_row_index + group_start,
+                               num_rows_in_group, seed=seed))
+    table = pa.concat_tables(tables)
+    filename = os.path.join(data_dir,
+                            f"input_data_{file_index}.parquet.snappy")
+    pq.write_table(table, filename, compression="snappy",
+                   row_group_size=rows_per_group)
+    return filename, table.nbytes
+
+
+def _file_plan(num_rows: int, num_files: int):
+    """(file_index, global_row_index, rows_in_file) covering all rows
+    (reference's stride arithmetic, data_generation.py:19-23)."""
+    rows_per_file = max(1, num_rows // num_files)
+    plan = []
+    for file_index, start in enumerate(range(0, num_rows, rows_per_file)):
+        plan.append((file_index, start, min(rows_per_file, num_rows - start)))
+    return plan
+
+
+def generate_data(num_rows: int, num_files: int,
+                  num_row_groups_per_file: int, max_row_group_skew: float,
+                  data_dir: str, seed: int = 0,
+                  num_workers: Optional[int] = None
+                  ) -> Tuple[List[str], int]:
+    """Parallel generation on the host pool (reference: data_generation.py:14-28)."""
+    assert max_row_group_skew == 0.0, "row-group skew is not implemented"
+    os.makedirs(data_dir, exist_ok=True)
+    with ex.Executor(num_workers=num_workers,
+                     thread_name_prefix="rsdl-datagen") as pool:
+        refs = [
+            pool.submit(generate_file, file_index, start, n,
+                        num_row_groups_per_file, data_dir, seed)
+            for file_index, start, n in _file_plan(num_rows, num_files)
+        ]
+        results = ex.get(refs)
+    filenames, sizes = zip(*results)
+    logger.info("generated %d files, %d rows, %.1f MB in-memory",
+                len(filenames), num_rows, sum(sizes) / 1e6)
+    return list(filenames), sum(sizes)
+
+
+def generate_data_local(num_rows: int, num_files: int,
+                        num_row_groups_per_file: int,
+                        max_row_group_skew: float, data_dir: str,
+                        seed: int = 0) -> Tuple[List[str], int]:
+    """Sequential variant (reference: data_generation.py:31-45)."""
+    assert max_row_group_skew == 0.0, "row-group skew is not implemented"
+    os.makedirs(data_dir, exist_ok=True)
+    results = [
+        generate_file(file_index, start, n, num_row_groups_per_file,
+                      data_dir, seed)
+        for file_index, start, n in _file_plan(num_rows, num_files)
+    ]
+    filenames, sizes = zip(*results)
+    return list(filenames), sum(sizes)

@@ -1,0 +1,299 @@
+"""JAX/TPU binding: shuffled batches as device-resident ``jax.Array``s.
+
+L4 equivalent of the reference's Torch binding (reference:
+torch_dataset.py:12-238): a column spec (names + shapes + dtypes for
+features, plus a label column) is normalized with the same rules, and each
+iterator batch is converted column-by-column into arrays shaped
+``(batch, *shape)`` (default ``(batch, 1)``).
+
+TPU-native design: instead of CPU torch tensors that the trainer later
+copies to GPU (reference: torch_dataset.py:206-238 + the trainer's
+``.cuda()`` at ray_torch_shuffle.py:189-192), conversion lands batches
+directly in device memory as sharded ``jax.Array``s: a background prefetch
+thread converts Arrow columns to NumPy (zero-copy where possible) and
+``jax.device_put``s the *next* batches onto the device mesh while the
+current step runs — double-buffering host->HBM copies behind compute.
+Batch-axis sharding uses ``NamedSharding(mesh, P("data", ...))`` so a
+multi-chip DP trainer receives its shard without any gather.
+
+The iterator also records the north-star stall metric — time blocked
+waiting for a batch (reference: ray_torch_shuffle.py:186-218) — in
+``batch_wait_stats``.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+import timeit
+from typing import Any, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+from ray_shuffling_data_loader_tpu.stats import BatchWaitStats
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+
+def _normalize_jax_data_spec(feature_columns=None,
+                             feature_shapes=None,
+                             feature_types=None,
+                             label_column=None,
+                             label_shape=None,
+                             label_type=None):
+    """Normalize the column spec with the reference's rules
+    (reference: torch_dataset.py:146-204): scalars become lists, shapes
+    must match feature count, dtypes default to float32.
+    """
+    import jax.numpy as jnp
+
+    if not isinstance(feature_columns, list):
+        feature_columns = [feature_columns]
+
+    if feature_shapes:
+        if not isinstance(feature_shapes, list):
+            feature_shapes = [feature_shapes]
+        if len(feature_columns) != len(feature_shapes):
+            raise ValueError(
+                "The feature_shapes size must match the feature_columns")
+        feature_shapes = [
+            tuple(s) if isinstance(s, (list, tuple))
+            else (None if s is None else (s,))
+            for s in feature_shapes
+        ]
+    else:
+        feature_shapes = [None] * len(feature_columns)
+
+    if feature_types:
+        if not isinstance(feature_types, list):
+            feature_types = [feature_types]
+        if len(feature_columns) != len(feature_types):
+            raise ValueError(
+                "The feature_types size must match the feature_columns")
+        feature_types = [np.dtype(t) for t in feature_types]
+    else:
+        feature_types = [np.dtype(jnp.float32)] * len(feature_columns)
+
+    if label_type is None:
+        label_type = np.dtype(jnp.float32)
+    else:
+        label_type = np.dtype(label_type)
+
+    return (feature_columns, feature_shapes, feature_types, label_column,
+            label_shape, label_type)
+
+
+def _column_to_numpy(column: pa.ChunkedArray, dtype: np.dtype) -> np.ndarray:
+    """Arrow column -> contiguous ndarray, zero-copy when types align.
+
+    Handles the reference's object-column cases (ndarray / list / tuple
+    cells, reference: torch_dataset.py:211-223): Arrow list columns become
+    stacked 2-D arrays.
+    """
+    combined = (column.chunk(0) if column.num_chunks == 1
+                else column.combine_chunks())
+    if pa.types.is_list(combined.type) or pa.types.is_large_list(combined.type) \
+            or pa.types.is_fixed_size_list(combined.type):
+        arr = np.stack(combined.to_numpy(zero_copy_only=False))
+    else:
+        arr = combined.to_numpy(zero_copy_only=False)
+        if arr.dtype == object:
+            first = arr[0] if len(arr) else None
+            if isinstance(first, np.ndarray):
+                arr = np.stack(arr)
+            elif isinstance(first, (list, tuple)):
+                arr = np.asarray([list(x) for x in arr])
+            else:
+                raise TypeError(
+                    f"Column cell type {type(first)} is not supported. It "
+                    "must be a numeric type or an object of (ndarray, list, "
+                    "tuple)")
+    return np.ascontiguousarray(arr.astype(dtype, copy=False))
+
+
+def convert_to_arrays(table: pa.Table,
+                      feature_columns: List[Any],
+                      feature_shapes: List[Optional[Tuple[int, ...]]],
+                      feature_types: List[np.dtype],
+                      label_column: Any,
+                      label_shape: Optional[int],
+                      label_type: np.dtype
+                      ) -> Tuple[List[np.ndarray], np.ndarray]:
+    """Arrow batch -> (per-feature arrays, label array), each reshaped to
+    ``(batch, *shape)`` / ``(batch, 1)`` (reference: torch_dataset.py:206-238).
+    """
+    features = []
+    for col, shape, dtype in zip(feature_columns, feature_shapes,
+                                 feature_types):
+        arr = _column_to_numpy(table.column(col), dtype)
+        if shape is not None:
+            arr = arr.reshape(-1, *shape)
+        elif arr.ndim == 1:
+            arr = arr.reshape(-1, 1)
+        features.append(arr)
+    label = _column_to_numpy(table.column(label_column), label_type)
+    if label_shape:
+        label = label.reshape(-1, label_shape)
+    elif label.ndim == 1:
+        label = label.reshape(-1, 1)
+    return features, label
+
+
+class JaxShufflingDataset:
+    """Shuffled batches as device-resident, optionally mesh-sharded
+    ``jax.Array``s, with prefetch double-buffering.
+
+    Constructor mirrors ``TorchShufflingDataset``
+    (reference: torch_dataset.py:43-78) plus the TPU knobs:
+
+    Args:
+        mesh: optional ``jax.sharding.Mesh``; batches are laid out with the
+            leading (batch) axis sharded over ``data_axis``.
+        data_axis: mesh axis name for the batch dimension.
+        prefetch_size: how many converted+transferred batches to keep ahead
+            of the consumer (2 = classic double buffering).
+        drop_last: fixed shapes are strongly recommended on TPU (a ragged
+            tail batch triggers one extra XLA compile), so this defaults to
+            True — unlike the reference.
+    """
+
+    def __init__(self,
+                 filenames: Sequence[str],
+                 num_epochs: int,
+                 num_trainers: int,
+                 batch_size: int,
+                 rank: int,
+                 feature_columns: List[Any] = None,
+                 feature_shapes: Optional[List[Any]] = None,
+                 feature_types: Optional[List[Any]] = None,
+                 label_column: Any = None,
+                 label_shape: Optional[int] = None,
+                 label_type: Optional[Any] = None,
+                 drop_last: bool = True,
+                 num_reducers: Optional[int] = None,
+                 max_concurrent_epochs: int = 2,
+                 batch_queue=None,
+                 shuffle_result=None,
+                 max_batch_queue_size: int = 0,
+                 seed: int = 0,
+                 num_workers: Optional[int] = None,
+                 queue_name: str = "MultiQueue",
+                 mesh=None,
+                 data_axis: str = "data",
+                 prefetch_size: int = 2,
+                 device_put: bool = True):
+        self._dataset = ShufflingDataset(
+            filenames, num_epochs, num_trainers, batch_size, rank,
+            drop_last=drop_last, num_reducers=num_reducers,
+            max_concurrent_epochs=max_concurrent_epochs,
+            batch_queue=batch_queue, shuffle_result=shuffle_result,
+            max_batch_queue_size=max_batch_queue_size, seed=seed,
+            num_workers=num_workers, queue_name=queue_name)
+        (self._feature_columns, self._feature_shapes, self._feature_types,
+         self._label_column, self._label_shape, self._label_type) = (
+             _normalize_jax_data_spec(feature_columns, feature_shapes,
+                                      feature_types, label_column,
+                                      label_shape, label_type))
+        self._mesh = mesh
+        self._data_axis = data_axis
+        self._prefetch_size = max(1, prefetch_size)
+        self._device_put = device_put
+        self.batch_wait_stats = BatchWaitStats()
+
+    def set_epoch(self, epoch: int) -> None:
+        self._dataset.set_epoch(epoch)
+
+    @property
+    def batch_size(self) -> int:
+        return self._dataset.batch_size
+
+    def _sharding(self, ndim: int):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if self._mesh is None:
+            return None
+        return NamedSharding(
+            self._mesh, P(self._data_axis, *([None] * (ndim - 1))))
+
+    def _transfer(self, arrays_label):
+        """Host arrays -> device arrays (sharded if a mesh was given)."""
+        import jax
+        features, label = arrays_label
+        if not self._device_put:
+            return features, label
+        out_features = [
+            jax.device_put(a, self._sharding(a.ndim)) for a in features
+        ]
+        out_label = jax.device_put(label, self._sharding(label.ndim))
+        return out_features, out_label
+
+    def _convert(self, table: pa.Table):
+        return convert_to_arrays(
+            table, self._feature_columns, self._feature_shapes,
+            self._feature_types, self._label_column, self._label_shape,
+            self._label_type)
+
+    def __iter__(self) -> Iterator[Tuple[List[Any], Any]]:
+        """Yield ``(features, label)`` device batches.
+
+        A background thread runs convert+device_put ``prefetch_size`` batches
+        ahead; ``jax.device_put`` is async (returns before the copy lands),
+        so the host->device DMA for batch N+1 overlaps the consumer's
+        compute on batch N.
+        """
+        if self._device_put:
+            # Force backend init on the calling thread: some PJRT plugins
+            # (e.g. the tunneled TPU client) deadlock if their first
+            # initialization happens on a worker thread.
+            import jax
+            jax.local_devices()
+        out: _queue.Queue = _queue.Queue(maxsize=self._prefetch_size)
+        SENTINEL = object()
+        stop = threading.Event()
+
+        def _put(item) -> bool:
+            """Bounded put that gives up when the consumer is gone."""
+            while not stop.is_set():
+                try:
+                    out.put(item, timeout=0.1)
+                    return True
+                except _queue.Full:
+                    continue
+            return False
+
+        def producer():
+            try:
+                for table in self._dataset:
+                    if not _put(self._transfer(self._convert(table))):
+                        return
+                _put(SENTINEL)
+            except BaseException as e:  # noqa: BLE001 - forwarded to consumer
+                _put(e)
+
+        thread = threading.Thread(target=producer, daemon=True,
+                                  name="rsdl-jax-prefetch")
+        thread.start()
+        try:
+            while True:
+                wait_start = timeit.default_timer()
+                item = out.get()
+                self.batch_wait_stats.record(
+                    timeit.default_timer() - wait_start)
+                if item is SENTINEL:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # Consumer done or abandoned mid-epoch: release the producer
+            # (it would otherwise block forever on the bounded queue,
+            # pinning device-resident batches) and drop buffered batches.
+            stop.set()
+            try:
+                while True:
+                    out.get_nowait()
+            except _queue.Empty:
+                pass
+            thread.join(timeout=5)

@@ -1,0 +1,96 @@
+"""Tabular MLP — the minimal end-to-end model (BASELINE.json config 1/2).
+
+Replaces the reference example's torch ``Net`` (reference:
+examples/horovod/ray_torch_shuffle.py:106-123, a 4-layer 22->512->...->1
+MLP over the DLRM-style tabular features) with a functional JAX model:
+``init(key) -> params`` pytree, ``apply(params, batch) -> logits``, plus a
+``param_specs`` tree of ``PartitionSpec``s so the same model runs replicated
+(DP) or Megatron-style tensor-parallel (hidden dim sharded over the
+"model" mesh axis) under jit without code changes.
+
+Design notes for TPU: all math is matmul-shaped for the MXU, compute dtype
+is bfloat16 with float32 params/accumulation, and layers are static Python
+loops over fixed shapes (one XLA graph, no dynamic control flow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class MLPConfig:
+    in_dim: int = 22
+    hidden_dims: Tuple[int, ...] = (512, 256, 128)
+    out_dim: int = 1
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def dims(self) -> Tuple[int, ...]:
+        return (self.in_dim, *self.hidden_dims, self.out_dim)
+
+
+def init(config: MLPConfig, key: jax.Array) -> Dict[str, Any]:
+    """He-initialized params, float32."""
+    params: Dict[str, Any] = {}
+    dims = config.dims
+    keys = jax.random.split(key, len(dims) - 1)
+    for i, (d_in, d_out) in enumerate(zip(dims[:-1], dims[1:])):
+        params[f"w{i}"] = jax.random.normal(
+            keys[i], (d_in, d_out), jnp.float32) * jnp.sqrt(2.0 / d_in)
+        params[f"b{i}"] = jnp.zeros((d_out,), jnp.float32)
+    return params
+
+
+def param_specs(config: MLPConfig, model_axis: str = "model"
+                ) -> Dict[str, P]:
+    """Megatron-style alternating column/row sharding of hidden layers.
+
+    Even layers split the output dim over ``model_axis``; odd layers split
+    the input dim, so activations stay sharded between the pair and XLA
+    inserts a single psum per pair — the standard TP pattern.
+    The final layer is replicated (out_dim=1 is unshardable).
+    """
+    specs: Dict[str, P] = {}
+    n_layers = len(config.dims) - 1
+    for i in range(n_layers):
+        last = i == n_layers - 1
+        if last:
+            specs[f"w{i}"] = P(None, None)
+            specs[f"b{i}"] = P(None)
+        elif i % 2 == 0:
+            specs[f"w{i}"] = P(None, model_axis)
+            specs[f"b{i}"] = P(model_axis)
+        else:
+            specs[f"w{i}"] = P(model_axis, None)
+            specs[f"b{i}"] = P(None)
+    return specs
+
+
+def apply(config: MLPConfig, params: Dict[str, Any],
+          features: jax.Array) -> jax.Array:
+    """Forward pass: bf16 matmuls, f32 output logits, shape (batch, out_dim)."""
+    x = features.astype(config.compute_dtype)
+    n_layers = len(config.dims) - 1
+    for i in range(n_layers):
+        w = params[f"w{i}"].astype(config.compute_dtype)
+        b = params[f"b{i}"].astype(config.compute_dtype)
+        x = x @ w + b
+        if i < n_layers - 1:
+            x = jax.nn.relu(x)
+    return x.astype(jnp.float32)
+
+
+def loss_fn(config: MLPConfig, params: Dict[str, Any], features: jax.Array,
+            labels: jax.Array) -> jax.Array:
+    """Sigmoid binary cross-entropy (the reference example's BCELoss,
+    reference: ray_torch_shuffle.py:168)."""
+    logits = apply(config, params, features)
+    return jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels
+        + jnp.log1p(jnp.exp(-jnp.abs(logits))))

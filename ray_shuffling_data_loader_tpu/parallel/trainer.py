@@ -1,0 +1,98 @@
+"""SPMD trainer: jitted train step over a device mesh.
+
+Replaces the reference's Horovod training harness (reference:
+examples/horovod/ray_torch_shuffle.py:126-243): instead of
+``hvd.DistributedOptimizer`` wrapping a torch optimizer with NCCL allreduce
+hooks (:173-177) and explicit parameter broadcast (:165-166), the whole
+train step — forward, backward, optimizer update — is one ``jax.jit``
+program over a ``Mesh``. Gradient synchronization is not written anywhere:
+batches arrive sharded along the "data" axis, params are replicated (or TP-
+sharded along "model"), and XLA inserts the ``psum``/``all_gather``
+collectives over ICI that the sharding layout implies. fp16 compression /
+Adasum knobs (:80-87) map to bf16 compute in the models and optax
+transforms here.
+
+The trainer owns sharded params + optimizer state and exposes
+``train_step(batch) -> loss``; donation keeps params/opt-state in place in
+HBM across steps (no host round-trips).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ray_shuffling_data_loader_tpu.parallel.mesh import DATA_AXIS
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+
+def make_train_step(loss_fn: Callable,
+                    optimizer: optax.GradientTransformation) -> Callable:
+    """Pure train-step function: (params, opt_state, *batch) ->
+    (params, opt_state, loss)."""
+
+    def train_step(params, opt_state, *batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, *batch)
+        updates, opt_state = optimizer.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, loss
+
+    return train_step
+
+
+class SpmdTrainer:
+    """Owns mesh-sharded training state and the compiled step.
+
+    Args:
+        mesh: the device mesh ("data" [, "model"]).
+        loss_fn: ``loss_fn(params, *batch) -> scalar``.
+        params: initial parameter pytree (host or device).
+        param_specs: pytree of ``PartitionSpec`` matching ``params``
+            (e.g. ``models.dlrm.param_specs(cfg)``); ``None`` = replicate
+            everything (pure DP).
+        optimizer: an optax ``GradientTransformation``.
+    """
+
+    def __init__(self,
+                 mesh: Mesh,
+                 loss_fn: Callable,
+                 params: Any,
+                 optimizer: optax.GradientTransformation,
+                 param_specs: Optional[Any] = None,
+                 donate: bool = True):
+        self.mesh = mesh
+        if param_specs is None:
+            param_specs = jax.tree.map(lambda _: P(), params)
+        self._param_shardings = jax.tree.map(
+            lambda spec: NamedSharding(mesh, spec), param_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        self.params = jax.device_put(params, self._param_shardings)
+        # Optimizer state sharding is inferred by XLA from the param
+        # shardings (mu/nu mirror params; scalars replicate).
+        self.opt_state = jax.jit(optimizer.init)(self.params)
+        step = make_train_step(loss_fn, optimizer)
+        self._step = jax.jit(
+            step, donate_argnums=(0, 1) if donate else ())
+
+    def train_step(self, *batch) -> jax.Array:
+        """One optimizer step; returns the (lazy) scalar loss."""
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, *batch)
+        return loss
+
+    def block_until_ready(self) -> None:
+        jax.block_until_ready((self.params, self.opt_state))
+
+
+def batch_shardings(mesh: Mesh, batch_example: Tuple,
+                    data_axis: str = DATA_AXIS):
+    """NamedShardings for a batch pytree: leading axis over ``data_axis``."""
+    return jax.tree.map(
+        lambda a: NamedSharding(
+            mesh, P(data_axis, *([None] * (a.ndim - 1)))),
+        batch_example)

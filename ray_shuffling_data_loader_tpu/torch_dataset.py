@@ -1,0 +1,166 @@
+"""Torch binding: shuffled batches as ``(List[Tensor], Tensor)``.
+
+Capability parity with the reference's L4 Torch layer (reference:
+torch_dataset.py:12-143): a ``torch.utils.data.IterableDataset`` over the
+shuffling pipeline whose column spec (features/shapes/dtypes + label) is
+normalized with the reference's rules and converted per column with
+``torch.as_tensor`` + reshape to ``(-1, *shape)`` / ``(-1, 1)``.
+
+This exists for drop-in migration from the reference; the TPU-native path
+is ``JaxShufflingDataset`` (jax_dataset.py), which lands batches in device
+memory instead of host torch tensors. Conversion reuses the same
+Arrow->NumPy column path, so object/list-column handling is identical
+across both bindings.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import numpy as np
+import torch
+from torch.utils.data import IterableDataset
+
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+from ray_shuffling_data_loader_tpu.jax_dataset import _column_to_numpy
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+# np dtype equivalents for the reference's torch dtype map
+# (reference: torch_dataset.py:269-281).
+_TORCH_TO_NUMPY = {
+    torch.float16: np.float16,
+    torch.float32: np.float32,
+    torch.float64: np.float64,
+    torch.int8: np.int8,
+    torch.int16: np.int16,
+    torch.int32: np.int32,
+    torch.int64: np.int64,
+    torch.uint8: np.uint8,
+    torch.bool: np.bool_,
+}
+
+
+def _normalize_torch_data_spec(feature_columns=None,
+                               feature_shapes=None,
+                               feature_types=None,
+                               label_column=None,
+                               label_shape=None,
+                               label_type=None):
+    """Reference rules (reference: torch_dataset.py:146-204): scalars ->
+    lists, shape/type lists must match the feature count, dtypes default to
+    ``torch.float``."""
+    if not isinstance(feature_columns, list):
+        feature_columns = [feature_columns]
+    if feature_shapes:
+        if not isinstance(feature_shapes, list):
+            feature_shapes = [feature_shapes]
+        if len(feature_columns) != len(feature_shapes):
+            raise ValueError(
+                "The feature_shapes size must match the feature_columns")
+        feature_shapes = [
+            tuple(s) if isinstance(s, (list, tuple))
+            else (None if s is None else (s,))
+            for s in feature_shapes
+        ]
+    else:
+        feature_shapes = [None] * len(feature_columns)
+    if feature_types:
+        if not isinstance(feature_types, list):
+            feature_types = [feature_types]
+        if len(feature_columns) != len(feature_types):
+            raise ValueError(
+                "The feature_types size must match the feature_columns")
+        for dtype in feature_types:
+            if not isinstance(dtype, torch.dtype):
+                raise TypeError(
+                    "All values in feature_types should be torch.dtype "
+                    f"instances, got {type(dtype)}")
+            if dtype not in _TORCH_TO_NUMPY:
+                raise ValueError(
+                    f"Unsupported feature dtype {dtype}; supported: "
+                    f"{sorted(map(str, _TORCH_TO_NUMPY))}")
+    else:
+        feature_types = [torch.float] * len(feature_columns)
+    if not label_type:
+        label_type = torch.float
+    if label_type not in _TORCH_TO_NUMPY:
+        raise ValueError(
+            f"Unsupported label dtype {label_type}; supported: "
+            f"{sorted(map(str, _TORCH_TO_NUMPY))}")
+    return (feature_columns, feature_shapes, feature_types, label_column,
+            label_shape, label_type)
+
+
+def convert_to_tensor(table, feature_columns: List[Any],
+                      feature_shapes: List[Any],
+                      feature_types: List[torch.dtype], label_column: Any,
+                      label_shape: Optional[int], label_type: torch.dtype):
+    """Arrow batch -> (List[Tensor], Tensor)
+    (reference: torch_dataset.py:206-238)."""
+    feature_tensor = []
+    for col, shape, dtype in zip(feature_columns, feature_shapes,
+                                 feature_types):
+        arr = _column_to_numpy(table.column(col),
+                               np.dtype(_TORCH_TO_NUMPY[dtype]))
+        t = torch.as_tensor(arr, dtype=dtype)
+        if shape is not None:
+            t = t.view(*(-1, *shape))
+        else:
+            t = t.view(-1, 1)
+        feature_tensor.append(t)
+    label_arr = _column_to_numpy(table.column(label_column),
+                                 np.dtype(_TORCH_TO_NUMPY[label_type]))
+    label_tensor = torch.as_tensor(label_arr, dtype=label_type)
+    if label_shape:
+        label_tensor = label_tensor.view(-1, label_shape)
+    else:
+        label_tensor = label_tensor.view(-1, 1)
+    return feature_tensor, label_tensor
+
+
+class TorchShufflingDataset(IterableDataset):
+    """IterableDataset over the shuffling pipeline
+    (reference: torch_dataset.py:12-94)."""
+
+    def __init__(self,
+                 filenames: Sequence[str],
+                 num_epochs: int,
+                 num_trainers: int,
+                 batch_size: int,
+                 rank: int,
+                 feature_columns: List[Any] = None,
+                 feature_shapes: Optional[List[Any]] = None,
+                 feature_types: Optional[List[torch.dtype]] = None,
+                 label_column: Any = None,
+                 label_shape: Optional[int] = None,
+                 label_type: Optional[torch.dtype] = None,
+                 drop_last: bool = False,
+                 num_reducers: Optional[int] = None,
+                 max_concurrent_epochs: int = 2,
+                 batch_queue=None,
+                 shuffle_result=None,
+                 max_batch_queue_size: int = 0,
+                 seed: int = 0,
+                 num_workers: Optional[int] = None,
+                 queue_name: str = "MultiQueue"):
+        super().__init__()
+        self._dataset = ShufflingDataset(
+            filenames, num_epochs, num_trainers, batch_size, rank,
+            drop_last=drop_last, num_reducers=num_reducers,
+            max_concurrent_epochs=max_concurrent_epochs,
+            batch_queue=batch_queue, shuffle_result=shuffle_result,
+            max_batch_queue_size=max_batch_queue_size, seed=seed,
+            num_workers=num_workers, queue_name=queue_name)
+        spec = _normalize_torch_data_spec(feature_columns, feature_shapes,
+                                          feature_types, label_column,
+                                          label_shape, label_type)
+        self._spec = spec
+
+    def set_epoch(self, epoch: int) -> None:
+        self._dataset.set_epoch(epoch)
+
+    def __iter__(self):
+        for table in self._dataset:
+            yield convert_to_tensor(table, *self._spec)

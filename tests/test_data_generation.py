@@ -1,0 +1,78 @@
+"""Tests for synthetic data generation (data_generation.py)."""
+
+import numpy as np
+import pyarrow.parquet as pq
+import pytest
+
+from ray_shuffling_data_loader_tpu import data_generation as dg
+
+
+def test_generate_data_local_layout(tmp_path):
+    filenames, num_bytes = dg.generate_data_local(
+        num_rows=1000, num_files=4, num_row_groups_per_file=2,
+        max_row_group_skew=0.0, data_dir=str(tmp_path))
+    assert len(filenames) == 4
+    assert all(f.endswith(".parquet.snappy") for f in filenames)
+    assert num_bytes > 0
+    total = 0
+    keys = []
+    for f in filenames:
+        table = pq.read_table(f)
+        assert set(table.column_names) == set(dg.DATA_SPEC) | {"key"}
+        total += table.num_rows
+        keys.extend(table.column("key").to_pylist())
+        meta = pq.ParquetFile(f).metadata
+        assert meta.num_row_groups == 2
+    assert total == 1000
+    assert sorted(keys) == list(range(1000))  # globally unique keys
+
+
+def test_generate_data_parallel_matches_local(tmp_path):
+    f_par, bytes_par = dg.generate_data(
+        num_rows=600, num_files=3, num_row_groups_per_file=1,
+        max_row_group_skew=0.0, data_dir=str(tmp_path / "par"), seed=7)
+    f_loc, bytes_loc = dg.generate_data_local(
+        num_rows=600, num_files=3, num_row_groups_per_file=1,
+        max_row_group_skew=0.0, data_dir=str(tmp_path / "loc"), seed=7)
+    assert bytes_par == bytes_loc
+    for fp, fl in zip(sorted(f_par), sorted(f_loc)):
+        tp, tl = pq.read_table(fp), pq.read_table(fl)
+        assert tp.equals(tl)  # identical data for identical seeds
+
+
+def test_cardinalities_respected(tmp_path):
+    filenames, _ = dg.generate_data_local(
+        num_rows=5000, num_files=1, num_row_groups_per_file=1,
+        max_row_group_skew=0.0, data_dir=str(tmp_path))
+    table = pq.read_table(filenames[0])
+    for col, (low, high, dtype) in dg.DATA_SPEC.items():
+        arr = np.asarray(table.column(col).to_numpy(zero_copy_only=False))
+        assert arr.min() >= low, col
+        if np.issubdtype(dtype, np.integer):
+            assert arr.max() < high, col
+        else:
+            assert arr.max() <= high, col
+
+
+def test_skew_not_implemented(tmp_path):
+    with pytest.raises(AssertionError):
+        dg.generate_data_local(100, 1, 1, 0.5, str(tmp_path))
+
+
+def test_seed_changes_data(tmp_path):
+    f1, _ = dg.generate_data_local(100, 1, 1, 0.0,
+                                   str(tmp_path / "a"), seed=1)
+    f2, _ = dg.generate_data_local(100, 1, 1, 0.0,
+                                   str(tmp_path / "b"), seed=2)
+    t1, t2 = pq.read_table(f1[0]), pq.read_table(f2[0])
+    assert not t1.equals(t2)
+
+
+def test_uneven_rows_covered(tmp_path):
+    filenames, _ = dg.generate_data_local(
+        num_rows=103, num_files=4, num_row_groups_per_file=1,
+        max_row_group_skew=0.0, data_dir=str(tmp_path))
+    keys = []
+    for f in filenames:
+        keys.extend(pq.read_table(f).column("key").to_pylist())
+    assert sorted(keys) == list(range(103))

@@ -1,0 +1,74 @@
+"""Tests for the Torch migration-compat binding (torch_dataset.py)."""
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+import torch
+
+from ray_shuffling_data_loader_tpu import multiqueue as mq
+from ray_shuffling_data_loader_tpu import torch_dataset as td
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    mq._REGISTRY.clear()
+    yield
+    mq._REGISTRY.clear()
+
+
+def test_spec_normalization_reference_rules():
+    cols, shapes, types, label, lshape, ltype = (
+        td._normalize_torch_data_spec(feature_columns="a",
+                                      label_column="y"))
+    assert cols == ["a"] and shapes == [None]
+    assert types == [torch.float] and ltype == torch.float
+    with pytest.raises(ValueError):
+        td._normalize_torch_data_spec(feature_columns=["a", "b"],
+                                      feature_shapes=[1], label_column="y")
+    with pytest.raises(TypeError):
+        td._normalize_torch_data_spec(feature_columns=["a"],
+                                      feature_types=[np.float32],
+                                      label_column="y")
+
+
+def test_convert_to_tensor():
+    table = pa.table({
+        "a": pa.array([1, 2, 3, 4], type=pa.int64()),
+        "y": pa.array([0.0, 1.0, 0.0, 1.0], type=pa.float64()),
+    })
+    spec = td._normalize_torch_data_spec(
+        feature_columns=["a"], feature_types=[torch.int32],
+        label_column="y")
+    features, label = td.convert_to_tensor(table, *spec)
+    assert isinstance(features, list) and len(features) == 1
+    assert features[0].dtype == torch.int32
+    assert features[0].shape == (4, 1)
+    assert label.dtype == torch.float and label.shape == (4, 1)
+
+
+def test_e2e_torch_dataset(tmp_path):
+    rng = np.random.default_rng(0)
+    path = str(tmp_path / "f.parquet")
+    pq.write_table(pa.table({
+        "key": pa.array(range(100), type=pa.int64()),
+        "feat": pa.array(rng.integers(0, 10, 100), type=pa.int64()),
+        "labels": pa.array(rng.random(100), type=pa.float64()),
+    }), path)
+    ds = td.TorchShufflingDataset(
+        [path], num_epochs=1, num_trainers=1, batch_size=25, rank=0,
+        feature_columns=["feat"], feature_types=[torch.long],
+        label_column="labels", num_reducers=2, seed=0,
+        queue_name="torch-e2e")
+    ds.set_epoch(0)
+    batches = list(ds)
+    assert len(batches) == 4
+    features, label = batches[0]
+    assert features[0].shape == (25, 1) and label.shape == (25, 1)
+
+
+def test_unsupported_torch_dtype_rejected_early():
+    with pytest.raises(ValueError):
+        td._normalize_torch_data_spec(
+            feature_columns=["a"], feature_types=[torch.bfloat16],
+            label_column="y")
