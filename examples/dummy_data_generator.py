@@ -1,0 +1,52 @@
+"""Generate identical dummy Parquet files on this host.
+
+Parity with the reference's per-node dummy generator (reference:
+examples/dummy_data_generator.py:11-36, a Fire CLI wrapping
+``generate_data_local``): run the same command on every TPU-VM host when
+there is no shared filesystem; the seeded generator makes the files
+bit-identical across hosts (the reference relies on unseeded luck).
+
+Usage:
+    python examples/dummy_data_generator.py --num-rows 1000000 \
+        --num-files 10 --data-dir /tmp/data
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_shuffling_data_loader_tpu.data_generation import (  # noqa: E402
+    generate_data_local)
+
+
+def generate_dummy_data_local(num_rows: int = 100_000,
+                              num_files: int = 10,
+                              num_row_groups_per_file: int = 1,
+                              max_row_group_skew: float = 0.0,
+                              data_dir: str = "./example_data",
+                              seed: int = 0):
+    filenames, num_bytes = generate_data_local(
+        num_rows, num_files, num_row_groups_per_file, max_row_group_skew,
+        data_dir, seed=seed)
+    print(f"Generated {len(filenames)} files ({num_bytes / 1e6:.1f} MB "
+          f"in-memory) in {data_dir}")
+    return filenames
+
+
+if __name__ == "__main__":
+    parser = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    parser.add_argument("--num-rows", type=int, default=100_000)
+    parser.add_argument("--num-files", type=int, default=10)
+    parser.add_argument("--num-row-groups-per-file", type=int, default=1)
+    parser.add_argument("--max-row-group-skew", type=float, default=0.0)
+    parser.add_argument("--data-dir", type=str, default="./example_data")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+    generate_dummy_data_local(args.num_rows, args.num_files,
+                              args.num_row_groups_per_file,
+                              args.max_row_group_skew, args.data_dir,
+                              args.seed)

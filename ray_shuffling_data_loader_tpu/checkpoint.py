@@ -1,0 +1,117 @@
+"""Loader-state checkpoint / resume.
+
+The reference has no checkpointing anywhere (SURVEY.md §5) and *cannot*
+add it: its shuffle draws from unseeded ``np.random`` in remote tasks
+(reference: shuffle.py:213,240), so a killed run can never reproduce the
+epoch order it was consuming. Our shuffle is keyed by (seed, epoch, task),
+which makes every epoch's batch stream a pure function of
+``(seed, epoch)`` — so resuming is just: re-run the shuffle for the
+current epoch and fast-skip the batches already consumed.
+
+``LoaderCheckpoint`` captures that state; ``resume_iterator`` wraps a
+dataset to skip + count batches and keep the checkpoint current.
+Checkpoints are JSON — human-readable, atomic-rename durable. Model/optimizer
+state belongs to orbax; this covers the input pipeline half, which is the
+half the reference ecosystem is missing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import tempfile
+from typing import Iterator, Optional
+
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+FORMAT_VERSION = 1
+
+
+@dataclasses.dataclass
+class LoaderCheckpoint:
+    """Everything needed to resume the input pipeline deterministically."""
+
+    seed: int
+    epoch: int
+    batches_consumed: int  # within the current epoch
+    num_epochs: int
+    num_trainers: int
+    rank: int
+    batch_size: int
+    version: int = FORMAT_VERSION
+
+    def save(self, path: str) -> None:
+        """Atomic write (tmp file + rename)."""
+        payload = json.dumps(dataclasses.asdict(self), indent=2)
+        directory = os.path.dirname(os.path.abspath(path))
+        os.makedirs(directory, exist_ok=True)
+        fd, tmp_path = tempfile.mkstemp(dir=directory, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                f.write(payload)
+            os.replace(tmp_path, path)
+        except BaseException:
+            if os.path.exists(tmp_path):
+                os.remove(tmp_path)
+            raise
+
+    @classmethod
+    def load(cls, path: str) -> "LoaderCheckpoint":
+        with open(path) as f:
+            data = json.load(f)
+        version = data.get("version", 0)
+        if version != FORMAT_VERSION:
+            raise ValueError(
+                f"checkpoint format version {version} != {FORMAT_VERSION}")
+        return cls(**data)
+
+
+def resume_iterator(dataset,
+                    checkpoint: LoaderCheckpoint,
+                    checkpoint_path: Optional[str] = None,
+                    checkpoint_every: int = 0) -> Iterator:
+    """Iterate ``dataset`` from ``checkpoint``, optionally persisting.
+
+    Re-derives the current epoch (the shuffle is seeded, so batch order
+    replays exactly), silently skips ``batches_consumed`` batches, then
+    yields the remainder and all later epochs. The caller must have
+    constructed ``dataset`` with the checkpoint's seed/batch_size/etc.
+    (validated here where the dataset exposes them).
+
+    With ``checkpoint_path`` set, the checkpoint advances after every
+    ``checkpoint_every`` batches (0 = only at epoch ends). Persistence is
+    **at-least-once**: a batch is recorded as consumed only when the caller
+    comes back for the next one, so a crash while processing batch N
+    replays batch N on resume — batches can repeat across a crash, but
+    none are ever skipped.
+    """
+    if getattr(dataset, "batch_size", checkpoint.batch_size) != \
+            checkpoint.batch_size:
+        raise ValueError(
+            f"dataset batch_size {dataset.batch_size} != checkpoint "
+            f"{checkpoint.batch_size}")
+
+    def _maybe_save():
+        if checkpoint_path is not None:
+            checkpoint.save(checkpoint_path)
+
+    for epoch in range(checkpoint.epoch, checkpoint.num_epochs):
+        skip = checkpoint.batches_consumed if epoch == checkpoint.epoch else 0
+        checkpoint.epoch = epoch
+        dataset.set_epoch(epoch)
+        index = 0
+        for batch in dataset:
+            index += 1
+            if index <= skip:
+                continue  # replayed batch, already consumed pre-crash
+            checkpoint.batches_consumed = index
+            yield batch
+            if checkpoint_every and index % checkpoint_every == 0:
+                _maybe_save()
+        checkpoint.batches_consumed = 0
+        if epoch + 1 < checkpoint.num_epochs:
+            checkpoint.epoch = epoch + 1
+        _maybe_save()

@@ -69,10 +69,14 @@ def create_batch_queue_and_shuffle(
         max_batch_queue_size: int = 0,
         seed: int = 0,
         num_workers: Optional[int] = None,
-        queue_name: str = MULTIQUEUE_NAME):
+        queue_name: str = MULTIQUEUE_NAME,
+        start_epoch: int = 0):
     """Driver-mode helper: create the queue and start the shuffle before any
     trainer exists, so every rank can be a pure consumer
     (reference: dataset.py:17-51)."""
+    if not 0 <= start_epoch <= num_epochs:
+        raise ValueError(
+            f"start_epoch {start_epoch} out of range [0, {num_epochs}]")
     batch_queue = mq.MultiQueue(
         num_epochs * num_trainers, max_batch_queue_size, name=queue_name)
     batch_queue.size(0)  # liveness probe kept for parity (dataset.py:106)
@@ -90,7 +94,8 @@ def create_batch_queue_and_shuffle(
         max_concurrent_epochs,
         seed=seed,
         num_workers=num_workers,
-        collect_stats=False)
+        collect_stats=False,
+        start_epoch=start_epoch)
     return batch_queue, shuffle_result
 
 
@@ -124,7 +129,8 @@ class ShufflingDataset:
                  max_batch_queue_size: int = 0,
                  seed: int = 0,
                  num_workers: Optional[int] = None,
-                 queue_name: str = MULTIQUEUE_NAME):
+                 queue_name: str = MULTIQUEUE_NAME,
+                 start_epoch: int = 0):
         if num_reducers is None:
             num_reducers = default_num_reducers(num_trainers)
         self._batch_size = batch_size
@@ -137,7 +143,8 @@ class ShufflingDataset:
                         filenames, num_epochs, num_trainers, batch_size,
                         max_concurrent_epochs, num_reducers,
                         max_batch_queue_size, seed=seed,
-                        num_workers=num_workers, queue_name=queue_name))
+                        num_workers=num_workers, queue_name=queue_name,
+                        start_epoch=start_epoch))
                 self._owns_queue = True
             else:
                 self._batch_queue = mq.MultiQueue(
@@ -147,6 +154,10 @@ class ShufflingDataset:
             self._batch_queue = batch_queue
             self._shuffle_result = shuffle_result
 
+        if not 0 <= start_epoch <= num_epochs:
+            raise ValueError(
+                f"start_epoch {start_epoch} out of range [0, {num_epochs}]")
+        self._start_epoch = start_epoch
         self._num_epochs = num_epochs
         self._num_trainers = num_trainers
         self._rank = rank
@@ -163,6 +174,11 @@ class ShufflingDataset:
     def set_epoch(self, epoch: int) -> None:
         """Declare the epoch about to be iterated. Must be called before
         each epoch's iteration (reference: dataset.py:147-157)."""
+        if epoch < self._start_epoch:
+            raise ValueError(
+                f"epoch {epoch} precedes start_epoch {self._start_epoch}; "
+                "epochs before the resume point are never shuffled and "
+                "iterating them would block forever")
         self._epoch = epoch
 
     def __iter__(self) -> Iterator[pa.Table]:

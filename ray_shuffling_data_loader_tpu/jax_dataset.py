@@ -184,14 +184,16 @@ class JaxShufflingDataset:
                  mesh=None,
                  data_axis: str = "data",
                  prefetch_size: int = 2,
-                 device_put: bool = True):
+                 device_put: bool = True,
+                 start_epoch: int = 0):
         self._dataset = ShufflingDataset(
             filenames, num_epochs, num_trainers, batch_size, rank,
             drop_last=drop_last, num_reducers=num_reducers,
             max_concurrent_epochs=max_concurrent_epochs,
             batch_queue=batch_queue, shuffle_result=shuffle_result,
             max_batch_queue_size=max_batch_queue_size, seed=seed,
-            num_workers=num_workers, queue_name=queue_name)
+            num_workers=num_workers, queue_name=queue_name,
+            start_epoch=start_epoch)
         (self._feature_columns, self._feature_shapes, self._feature_types,
          self._label_column, self._label_shape, self._label_type) = (
              _normalize_jax_data_spec(feature_columns, feature_shapes,

@@ -1,0 +1,185 @@
+"""BERT-style masked-LM — sequence model family (BASELINE.json config 4:
+BERT-base MLM on pre-tokenized Wikipedia Parquet).
+
+The reference treats sequence workloads purely as "batch pre-tokenized
+fixed-length rows" (SURVEY.md §5: no sequence-parallel machinery exists or
+is needed); the loader delivers (batch, seq_len) int token tables and this
+model consumes them. Same functional API as the other families: ``init``,
+``apply``, ``loss_fn``, ``param_specs``.
+
+TPU-first choices:
+- Megatron TP sharding spec: QKV and FFN-in split column-wise over
+  "model", attention-out and FFN-out split row-wise, so each transformer
+  block needs exactly two psums; embeddings column-sharded.
+- bf16 compute / f32 params & softmax accumulation; static seq_len, fused
+  QKV projection; attention is two batched matmuls on the MXU.
+- MLM loss masks with a -100 ignore-id convention (positions to predict
+  carry their target id, others -100).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+IGNORE_ID = -100
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30_522
+    hidden_dim: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_dim: int = 3072
+    max_seq_len: int = 512
+    compute_dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_dim // self.num_heads
+
+
+def bert_base() -> BertConfig:
+    return BertConfig()
+
+
+def bert_tiny() -> BertConfig:
+    """For tests/CPU smoke runs."""
+    return BertConfig(vocab_size=1000, hidden_dim=64, num_layers=2,
+                      num_heads=4, ffn_dim=128, max_seq_len=64)
+
+
+def init(config: BertConfig, key: jax.Array) -> Dict[str, Any]:
+    h, f = config.hidden_dim, config.ffn_dim
+    keys = iter(jax.random.split(key, 3 + config.num_layers * 6))
+    scale = 0.02
+    params: Dict[str, Any] = {
+        "token_emb": scale * jax.random.normal(
+            next(keys), (config.vocab_size, h), jnp.float32),
+        "pos_emb": scale * jax.random.normal(
+            next(keys), (config.max_seq_len, h), jnp.float32),
+        "emb_ln": {"scale": jnp.ones((h,), jnp.float32),
+                   "bias": jnp.zeros((h,), jnp.float32)},
+    }
+    for layer in range(config.num_layers):
+        lp = {
+            "qkv_w": scale * jax.random.normal(next(keys), (h, 3 * h),
+                                               jnp.float32),
+            "qkv_b": jnp.zeros((3 * h,), jnp.float32),
+            "attn_out_w": scale * jax.random.normal(next(keys), (h, h),
+                                                    jnp.float32),
+            "attn_out_b": jnp.zeros((h,), jnp.float32),
+            "ln1": {"scale": jnp.ones((h,), jnp.float32),
+                    "bias": jnp.zeros((h,), jnp.float32)},
+            "ffn_in_w": scale * jax.random.normal(next(keys), (h, f),
+                                                  jnp.float32),
+            "ffn_in_b": jnp.zeros((f,), jnp.float32),
+            "ffn_out_w": scale * jax.random.normal(next(keys), (f, h),
+                                                   jnp.float32),
+            "ffn_out_b": jnp.zeros((h,), jnp.float32),
+            "ln2": {"scale": jnp.ones((h,), jnp.float32),
+                    "bias": jnp.zeros((h,), jnp.float32)},
+        }
+        params[f"layer_{layer}"] = lp
+    params["mlm_bias"] = jnp.zeros((config.vocab_size,), jnp.float32)
+    return params
+
+
+def param_specs(config: BertConfig, model_axis: str = "model"
+                ) -> Dict[str, Any]:
+    ln = {"scale": P(None), "bias": P(None)}
+    specs: Dict[str, Any] = {
+        "token_emb": P(None, model_axis),
+        "pos_emb": P(None, model_axis),
+        "emb_ln": dict(ln),
+        "mlm_bias": P(None),
+    }
+    for layer in range(config.num_layers):
+        specs[f"layer_{layer}"] = {
+            "qkv_w": P(None, model_axis),
+            "qkv_b": P(model_axis),
+            "attn_out_w": P(model_axis, None),
+            "attn_out_b": P(None),
+            "ln1": dict(ln),
+            "ffn_in_w": P(None, model_axis),
+            "ffn_in_b": P(model_axis),
+            "ffn_out_w": P(model_axis, None),
+            "ffn_out_b": P(None),
+            "ln2": dict(ln),
+        }
+    return specs
+
+
+def _layer_norm(x, scale, bias, eps=1e-12):
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(axis=-1, keepdims=True)
+    var = xf.var(axis=-1, keepdims=True)
+    out = (xf - mean) * jax.lax.rsqrt(var + eps) * scale + bias
+    return out.astype(x.dtype)
+
+
+def apply(config: BertConfig, params: Dict[str, Any],
+          token_ids: jax.Array,
+          attention_mask: jax.Array = None) -> jax.Array:
+    """token_ids (B, S) int32 -> logits (B, S, vocab).
+
+    ``attention_mask`` (B, S) with 1 = attend, 0 = padding; None = all 1.
+    """
+    dtype = config.compute_dtype
+    b, s = token_ids.shape
+    h, nh, hd = config.hidden_dim, config.num_heads, config.head_dim
+
+    x = (jnp.take(params["token_emb"], token_ids, axis=0, mode="clip")
+         + params["pos_emb"][:s][None, :, :]).astype(dtype)
+    x = _layer_norm(x, params["emb_ln"]["scale"], params["emb_ln"]["bias"])
+
+    if attention_mask is None:
+        bias = jnp.zeros((b, 1, 1, s), jnp.float32)
+    else:
+        bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
+                         -1e9).astype(jnp.float32)
+
+    for layer in range(config.num_layers):
+        lp = params[f"layer_{layer}"]
+        qkv = x @ lp["qkv_w"].astype(dtype) + lp["qkv_b"].astype(dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+        k = k.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+        v = v.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+        scores = scores / jnp.sqrt(hd) + bias
+        weights = jax.nn.softmax(scores, axis=-1).astype(dtype)
+        attended = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+        attended = attended.transpose(0, 2, 1, 3).reshape(b, s, h)
+        attn_out = (attended @ lp["attn_out_w"].astype(dtype)
+                    + lp["attn_out_b"].astype(dtype))
+        x = _layer_norm(x + attn_out, lp["ln1"]["scale"], lp["ln1"]["bias"])
+        ffn = jax.nn.gelu(x @ lp["ffn_in_w"].astype(dtype)
+                          + lp["ffn_in_b"].astype(dtype))
+        ffn = ffn @ lp["ffn_out_w"].astype(dtype) + lp["ffn_out_b"].astype(dtype)
+        x = _layer_norm(x + ffn, lp["ln2"]["scale"], lp["ln2"]["bias"])
+
+    # MLM head: tied to the token embedding (standard BERT).
+    logits = jnp.einsum("bsh,vh->bsv", x,
+                        params["token_emb"].astype(dtype))
+    return logits.astype(jnp.float32) + params["mlm_bias"]
+
+
+def loss_fn(config: BertConfig, params: Dict[str, Any],
+            token_ids: jax.Array, mlm_targets: jax.Array,
+            attention_mask: jax.Array = None) -> jax.Array:
+    """Masked-LM cross-entropy over positions where targets != IGNORE_ID."""
+    logits = apply(config, params, token_ids, attention_mask)
+    mask = (mlm_targets != IGNORE_ID)
+    safe_targets = jnp.where(mask, mlm_targets, 0).astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    token_logp = jnp.take_along_axis(
+        logp, safe_targets[..., None], axis=-1)[..., 0]
+    total = jnp.sum(jnp.where(mask, -token_logp, 0.0))
+    count = jnp.maximum(jnp.sum(mask), 1)
+    return total / count

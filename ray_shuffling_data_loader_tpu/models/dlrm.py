@@ -127,7 +127,9 @@ def apply(config: DLRMConfig, params: Dict[str, Any],
     vectors = []
     for i in range(config.num_sparse):
         table = params["embeddings"][f"table_{i}"].astype(dtype)
-        vectors.append(jnp.take(table, sparse[:, i], axis=0))
+        # mode="clip": JAX's default out-of-bounds gather fills NaN; clipping
+        # keeps a stray bad index from poisoning the whole step.
+        vectors.append(jnp.take(table, sparse[:, i], axis=0, mode="clip"))
     if config.dense_dim > 0:
         bottom_cfg = _mlp_cfg(config.dense_dim, config.bottom_hidden,
                               config.embed_dim, dtype)
@@ -146,6 +148,29 @@ def apply(config: DLRMConfig, params: Dict[str, Any],
         [interactions, first_order], axis=1).astype(dtype)
     top_cfg = _mlp_cfg(config.top_in_dim, config.top_hidden, 1, dtype)
     return mlp_mod.apply(top_cfg, params["top"], top_in)
+
+
+def validate_sparse_batch(config: DLRMConfig, sparse) -> None:
+    """Host-side bounds check for a sparse index batch.
+
+    ``apply`` clips out-of-range indices on device (a stray bad index must
+    not NaN a step), which also means a *systematically* broken pipeline
+    would train silently on edge rows — run this on the host batch (e.g.
+    every N steps or in a debug mode) to surface corruption loudly.
+    """
+    import numpy as np
+    arr = np.asarray(sparse)
+    if arr.shape[-1] != config.num_sparse:
+        raise ValueError(
+            f"expected {config.num_sparse} sparse features, got "
+            f"{arr.shape[-1]}")
+    mins = arr.min(axis=0)
+    maxs = arr.max(axis=0)
+    for i, vocab in enumerate(config.vocab_sizes):
+        if mins[i] < 0 or maxs[i] >= vocab:
+            raise ValueError(
+                f"sparse feature {i} has indices in [{mins[i]}, {maxs[i]}] "
+                f"outside vocab [0, {vocab})")
 
 
 def loss_fn(config: DLRMConfig, params: Dict[str, Any],

@@ -1,0 +1,188 @@
+"""ResNet — image model family (BASELINE.json config 3:
+ResNet-50 on ImageNet Parquet shards).
+
+The reference never ships an image model (its only net is the tabular MLP
+in examples/horovod/ray_torch_shuffle.py:106-123); this covers the
+ResNet-50/ImageNet-Parquet target workload with the same functional API as
+the other model families: ``init``, ``apply``, ``loss_fn``, ``param_specs``.
+
+TPU-first choices:
+- **GroupNorm instead of BatchNorm**: no running statistics, so the model
+  stays a pure function (no mutable state threading through jit) and needs
+  no cross-replica batch-stat sync; standard practice for JAX ResNets.
+- NHWC layout (XLA's native conv layout on TPU), bf16 compute with f32
+  params, 3x3/1x1 convs that tile straight onto the MXU.
+- TP sharding spec: conv output channels over the "model" axis for the
+  widest (stage-3/4) blocks, final FC Megatron-split.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    num_classes: int = 1000
+    num_groups: int = 32
+    compute_dtype: Any = jnp.bfloat16
+
+
+def resnet50(num_classes: int = 1000) -> ResNetConfig:
+    return ResNetConfig(stage_sizes=(3, 4, 6, 3), num_classes=num_classes)
+
+
+def resnet18_cifar(num_classes: int = 10) -> ResNetConfig:
+    """Small variant for tests/CPU smoke runs."""
+    return ResNetConfig(stage_sizes=(1, 1), width=16, num_classes=num_classes,
+                        num_groups=8)
+
+
+def _conv_init(key, kh, kw, cin, cout):
+    fan_in = kh * kw * cin
+    return jax.random.normal(key, (kh, kw, cin, cout),
+                             jnp.float32) * jnp.sqrt(2.0 / fan_in)
+
+
+def _gn_params(c):
+    return {"scale": jnp.ones((c,), jnp.float32),
+            "bias": jnp.zeros((c,), jnp.float32)}
+
+
+def init(config: ResNetConfig, key: jax.Array) -> Dict[str, Any]:
+    params: Dict[str, Any] = {}
+    n_stages = len(config.stage_sizes)
+    keys = iter(jax.random.split(key, 4 + sum(config.stage_sizes) * 4))
+    params["stem_conv"] = _conv_init(next(keys), 7, 7, 3, config.width)
+    params["stem_gn"] = _gn_params(config.width)
+    cin = config.width
+    for stage, num_blocks in enumerate(config.stage_sizes):
+        cmid = config.width * (2 ** stage)
+        cout = cmid * 4
+        for block in range(num_blocks):
+            name = f"s{stage}b{block}"
+            params[f"{name}_conv1"] = _conv_init(next(keys), 1, 1, cin, cmid)
+            params[f"{name}_gn1"] = _gn_params(cmid)
+            params[f"{name}_conv2"] = _conv_init(next(keys), 3, 3, cmid, cmid)
+            params[f"{name}_gn2"] = _gn_params(cmid)
+            params[f"{name}_conv3"] = _conv_init(next(keys), 1, 1, cmid, cout)
+            params[f"{name}_gn3"] = _gn_params(cout)
+            if block == 0:
+                params[f"{name}_proj"] = _conv_init(next(keys), 1, 1, cin,
+                                                    cout)
+                params[f"{name}_proj_gn"] = _gn_params(cout)
+            cin = cout
+    params["fc_w"] = jax.random.normal(
+        next(keys), (cin, config.num_classes),
+        jnp.float32) * jnp.sqrt(1.0 / cin)
+    params["fc_b"] = jnp.zeros((config.num_classes,), jnp.float32)
+    return params
+
+
+def _param_names(config: ResNetConfig):
+    yield "stem_conv"
+    yield "stem_gn"
+    for stage, num_blocks in enumerate(config.stage_sizes):
+        for block in range(num_blocks):
+            name = f"s{stage}b{block}"
+            for suffix in ("_conv1", "_gn1", "_conv2", "_gn2", "_conv3",
+                           "_gn3"):
+                yield name + suffix
+            if block == 0:
+                yield name + "_proj"
+                yield name + "_proj_gn"
+    yield "fc_w"
+    yield "fc_b"
+
+
+def param_specs(config: ResNetConfig, model_axis: str = "model"
+                ) -> Dict[str, Any]:
+    """Channel-sharded convs (output-channel dim over the model axis);
+    GN params replicated; final FC Megatron-split on its input dim."""
+    specs: Dict[str, Any] = {}
+    for name in _param_names(config):
+        if "_gn" in name or name == "stem_gn":
+            specs[name] = {"scale": P(None), "bias": P(None)}
+        elif name == "fc_w":
+            specs[name] = P(model_axis, None)
+        elif name == "fc_b":
+            specs[name] = P(None)
+        else:  # conv kernels (kh, kw, cin, cout): shard cout
+            specs[name] = P(None, None, None, model_axis)
+    return specs
+
+
+def _group_norm(x, scale, bias, num_groups, eps=1e-5):
+    n, h, w, c = x.shape
+    groups = min(num_groups, c)
+    while c % groups:
+        groups -= 1
+    xg = x.reshape(n, h, w, groups, c // groups).astype(jnp.float32)
+    mean = xg.mean(axis=(1, 2, 4), keepdims=True)
+    var = xg.var(axis=(1, 2, 4), keepdims=True)
+    xg = (xg - mean) * jax.lax.rsqrt(var + eps)
+    out = xg.reshape(n, h, w, c)
+    return (out * scale + bias).astype(x.dtype)
+
+
+def _conv(x, kernel, stride=1):
+    return jax.lax.conv_general_dilated(
+        x, kernel.astype(x.dtype), window_strides=(stride, stride),
+        padding="SAME", dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+def apply(config: ResNetConfig, params: Dict[str, Any],
+          images: jax.Array) -> jax.Array:
+    """images (N, H, W, 3) -> logits (N, num_classes)."""
+    dtype = config.compute_dtype
+    x = images.astype(dtype)
+    x = _conv(x, params["stem_conv"], stride=2)
+    x = _group_norm(x, params["stem_gn"]["scale"], params["stem_gn"]["bias"],
+                    config.num_groups)
+    x = jax.nn.relu(x)
+    x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
+                              (1, 2, 2, 1), "SAME")
+    for stage, num_blocks in enumerate(config.stage_sizes):
+        for block in range(num_blocks):
+            name = f"s{stage}b{block}"
+            stride = 2 if (stage > 0 and block == 0) else 1
+            residual = x
+            y = _conv(x, params[f"{name}_conv1"])
+            y = _group_norm(y, params[f"{name}_gn1"]["scale"],
+                            params[f"{name}_gn1"]["bias"], config.num_groups)
+            y = jax.nn.relu(y)
+            y = _conv(y, params[f"{name}_conv2"], stride=stride)
+            y = _group_norm(y, params[f"{name}_gn2"]["scale"],
+                            params[f"{name}_gn2"]["bias"], config.num_groups)
+            y = jax.nn.relu(y)
+            y = _conv(y, params[f"{name}_conv3"])
+            y = _group_norm(y, params[f"{name}_gn3"]["scale"],
+                            params[f"{name}_gn3"]["bias"], config.num_groups)
+            if f"{name}_proj" in params:
+                residual = _conv(residual, params[f"{name}_proj"],
+                                 stride=stride)
+                residual = _group_norm(
+                    residual, params[f"{name}_proj_gn"]["scale"],
+                    params[f"{name}_proj_gn"]["bias"], config.num_groups)
+            x = jax.nn.relu(y + residual)
+    x = x.mean(axis=(1, 2))  # global average pool
+    logits = (x @ params["fc_w"].astype(dtype)
+              + params["fc_b"].astype(dtype))
+    return logits.astype(jnp.float32)
+
+
+def loss_fn(config: ResNetConfig, params: Dict[str, Any],
+            images: jax.Array, labels: jax.Array) -> jax.Array:
+    """Softmax cross-entropy; labels are int class ids (N,) or (N, 1)."""
+    logits = apply(config, params, images)
+    labels = labels.reshape(-1).astype(jnp.int32)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.take_along_axis(
+        logp, labels[:, None], axis=1))

@@ -161,7 +161,8 @@ def shuffle(filenames: Sequence[str],
             seed: int = 0,
             num_workers: Optional[int] = None,
             collect_stats: bool = True,
-            pool: Optional[ex.Executor] = None
+            pool: Optional[ex.Executor] = None,
+            start_epoch: int = 0
             ) -> Union[stats_mod.TrialStats, float]:
     """Multi-epoch pipelined shuffle driver (reference: shuffle.py:79-160).
 
@@ -170,11 +171,22 @@ def shuffle(filenames: Sequence[str],
     reducers and then drops their refs so Arrow buffers already consumed
     by trainers can be freed (reference: shuffle.py:103-140).
 
+    ``start_epoch`` > 0 (checkpoint resume) skips shuffling the already-
+    fully-consumed epochs; epoch PRNG keys depend only on (seed, epoch),
+    so the produced epochs replay exactly.
+
     Returns ``TrialStats`` when ``collect_stats`` else the wall-clock
     duration in seconds (reference: shuffle.py:155-160).
     """
+    if not 0 <= start_epoch <= num_epochs:
+        raise ValueError(
+            f"start_epoch {start_epoch} out of range [0, {num_epochs}]")
     stats_collector = None
     if collect_stats:
+        if start_epoch:
+            raise ValueError(
+                "collect_stats with start_epoch > 0 is unsupported (stats "
+                "collectors assume all epochs run)")
         stats_collector = stats_mod.TrialStatsCollector(
             num_epochs, num_maps=len(filenames), num_reduces=num_reducers,
             num_consumes=num_trainers)
@@ -186,7 +198,7 @@ def shuffle(filenames: Sequence[str],
         pool = ex.Executor(num_workers=num_workers)
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}
-        for epoch_idx in range(num_epochs):
+        for epoch_idx in range(start_epoch, num_epochs):
             throttle_start = timeit.default_timer()
             while len(in_progress) >= max_concurrent_epochs:
                 oldest_epoch = min(in_progress)
@@ -273,7 +285,8 @@ def run_shuffle_in_background(
         max_concurrent_epochs: int = 2,
         seed: int = 0,
         num_workers: Optional[int] = None,
-        collect_stats: bool = False) -> ex.TaskRef:
+        collect_stats: bool = False,
+        start_epoch: int = 0) -> ex.TaskRef:
     """Launch the whole multi-epoch shuffle as one background task.
 
     Stands in for the reference driver's ``ray.remote(shuffle).remote(...)``
@@ -289,7 +302,8 @@ def run_shuffle_in_background(
             return shuffle(filenames, batch_consumer, num_epochs,
                            num_reducers, num_trainers, max_concurrent_epochs,
                            seed=seed, num_workers=num_workers,
-                           collect_stats=collect_stats)
+                           collect_stats=collect_stats,
+                           start_epoch=start_epoch)
         finally:
             driver_pool.shutdown(wait_for_tasks=False)
 

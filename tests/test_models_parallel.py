@@ -145,3 +145,23 @@ def test_graft_dryrun_multichip():
     import __graft_entry__ as ge
     ge.dryrun_multichip(8)
     ge.dryrun_multichip(4)
+
+
+def test_dlrm_out_of_range_index_clips_not_nan():
+    cfg = dlrm.DLRMConfig(vocab_sizes=(8, 8), embed_dim=8, top_hidden=(8,))
+    params = dlrm.init(cfg, jax.random.key(0))
+    sparse = jnp.asarray([[7, 500], [9999, 3]], dtype=jnp.int32)
+    out = dlrm.apply(cfg, params, None, sparse)
+    assert np.all(np.isfinite(np.asarray(out)))
+
+
+def test_dlrm_validate_sparse_batch():
+    cfg = dlrm.DLRMConfig(vocab_sizes=(8, 8), embed_dim=8, top_hidden=(8,))
+    good = np.asarray([[0, 7], [3, 2]], np.int32)
+    dlrm.validate_sparse_batch(cfg, good)
+    with pytest.raises(ValueError):
+        dlrm.validate_sparse_batch(cfg, np.asarray([[0, 8]], np.int32))
+    with pytest.raises(ValueError):
+        dlrm.validate_sparse_batch(cfg, np.asarray([[-1, 0]], np.int32))
+    with pytest.raises(ValueError):
+        dlrm.validate_sparse_batch(cfg, np.asarray([[0, 1, 2]], np.int32))
