@@ -18,9 +18,17 @@ Single host (drives all local devices):
         --num-epochs 3 --batch-size 8192
 
 Multi-host (one process per TPU-VM host, launched on every host):
+    RSDL_HOSTS="host0:18515,host1:18515" \
     python examples/jax_train_shuffle.py --distributed ...
-    # rank/world come from jax.distributed; each host shuffles its own
-    # shard of the file list and feeds its local devices.
+    # rank/world come from jax.distributed. With RSDL_HOSTS set, hosts run
+    # the GLOBAL distributed shuffle (cross-host reducer exchange over the
+    # host network, parallel/distributed.py); otherwise each host shuffles
+    # only its own contiguous shard of the file list. Either way the train
+    # step is one jit program over the global mesh: every host assembles
+    # the global batch from its local shard
+    # (jax.make_array_from_process_local_data) and XLA psums gradients
+    # over ICI/DCN. A per-step all-hosts continue-vote keeps collective
+    # programs aligned when hosts' epochs have unequal batch counts.
 """
 
 from __future__ import annotations
@@ -95,12 +103,11 @@ def main(argv=None):
         filenames, _ = dg.generate_data(
             args.num_rows, args.num_files, args.num_row_groups_per_file,
             0.0, args.data_dir, seed=args.seed)
-    # Each host shuffles its contiguous shard of the file list
-    # (deterministic shard routing: no cross-host queues needed).
-    local_files = [f for i, f in enumerate(sorted(filenames))
-                   if i % world == rank]
-
-    mesh = mesh_mod.make_mesh()  # local-device DP mesh
+    # Global ("data",) DP mesh over every chip of every host. In
+    # distributed mode each host contributes its local shard of each global
+    # batch; single-host this is just the local devices.
+    mesh = mesh_mod.make_mesh()
+    multi_host = world > 1
     if args.tiny_model:
         # Indices above the capped vocab are clipped by jnp.take's default
         # out-of-bounds mode — fine for a smoke run.
@@ -117,26 +124,88 @@ def main(argv=None):
             mesh, lambda p, s, y: dlrm.loss_fn(cfg, p, None, s, y),
             params, optax.adam(args.learning_rate))
 
-    ds = JaxShufflingDataset(
-        local_files, num_epochs=args.num_epochs, num_trainers=1,
+    from ray_shuffling_data_loader_tpu.utils.config import default_num_reducers
+    sorted_files = sorted(filenames)
+    dataset_kwargs = dict(
+        num_epochs=args.num_epochs, num_trainers=1,
         batch_size=args.batch_size, rank=0,
         feature_columns=list(dg.FEATURE_COLUMNS),
         feature_types=[np.int32] * len(dg.FEATURE_COLUMNS),
-        label_column=dg.LABEL_COLUMN, num_reducers=args.num_reducers,
+        label_column=dg.LABEL_COLUMN,
         max_concurrent_epochs=args.max_concurrent_epochs, seed=args.seed,
-        mesh=mesh, drop_last=True,
-        queue_name=f"example-queue-{rank}")
+        drop_last=True, queue_name=f"example-queue-{rank}")
+    transport = None
+    if multi_host and os.environ.get("RSDL_HOSTS"):
+        # GLOBAL shuffle: rows from any host's files can reach any trainer
+        # (the reference's cluster-wide semantics). RSDL_HOSTS lists every
+        # host's shuffle endpoint, same order as jax.process_index.
+        from ray_shuffling_data_loader_tpu.parallel.distributed import (
+            create_distributed_batch_queue_and_shuffle)
+        from ray_shuffling_data_loader_tpu.parallel.transport import TcpTransport
+        addresses = []
+        for spec in os.environ["RSDL_HOSTS"].split(","):
+            host, _, port = spec.strip().rpartition(":")
+            addresses.append((host, int(port)))
+        assert len(addresses) == world, "RSDL_HOSTS entries != process count"
+        transport = TcpTransport(rank, addresses)
+        transport.start()
+        transport.connect()
+        batch_queue, shuffle_result = (
+            create_distributed_batch_queue_and_shuffle(
+                sorted_files, args.num_epochs,
+                args.num_reducers or default_num_reducers(world), transport,
+                max_concurrent_epochs=args.max_concurrent_epochs,
+                seed=args.seed, queue_name=dataset_kwargs["queue_name"]))
+        ds = JaxShufflingDataset(
+            sorted_files, batch_queue=batch_queue,
+            shuffle_result=shuffle_result,
+            # In multi-host mode batches stay host-local numpy; the global
+            # device array is assembled below.
+            device_put=False, **dataset_kwargs)
+    else:
+        # Per-host shuffle of a contiguous file shard (deterministic shard
+        # routing — no cross-host exchange, weaker global mixing).
+        local_files = [f for i, f in enumerate(sorted_files)
+                       if i % world == rank]
+        ds = JaxShufflingDataset(
+            local_files, num_reducers=args.num_reducers,
+            mesh=None if multi_host else mesh,
+            device_put=not multi_host, **dataset_kwargs)
 
     import jax.numpy as jnp
+    if multi_host:
+        from jax.experimental import multihost_utils
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def to_global(arr):
+            return jax.make_array_from_process_local_data(
+                NamedSharding(mesh, P("data", *([None] * (arr.ndim - 1)))),
+                np.asarray(arr))
+
     for epoch in range(args.num_epochs):
         ds.set_epoch(epoch)
         epoch_start = timeit.default_timer()
         steps, last_loss = 0, float("nan")
-        for features, label in ds:
+        it = iter(ds)
+        while True:
+            batch = next(it, None)
+            if multi_host:
+                # Continue-vote: all hosts step, or none do — keeps every
+                # host issuing the same sequence of collective programs
+                # even when per-host batch counts differ by one.
+                votes = multihost_utils.process_allgather(
+                    np.array([batch is not None]))
+                if not votes.all():
+                    break
+            elif batch is None:
+                break
+            features, label = batch
             if args.mock_train_step_time is not None:
                 time.sleep(args.mock_train_step_time)
             else:
                 sparse = jnp.concatenate(features, axis=1)
+                if multi_host:
+                    sparse, label = to_global(sparse), to_global(label)
                 last_loss = trainer.train_step(sparse, label)
             steps += 1
         if trainer is not None:
@@ -153,6 +222,8 @@ def main(argv=None):
     print(f"[rank {rank}] DONE: {waits['count']} batches, "
           f"total stall {waits['total']:.2f}s "
           f"(mean {waits['mean'] * 1e3:.1f}ms/batch)")
+    if transport is not None:
+        transport.close()
 
 
 if __name__ == "__main__":

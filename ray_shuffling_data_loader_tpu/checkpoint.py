@@ -44,7 +44,7 @@ class LoaderCheckpoint:
     version: int = FORMAT_VERSION
 
     def save(self, path: str) -> None:
-        """Atomic write (tmp file + rename)."""
+        """Atomic durable write: tmp file + fsync + rename + dir fsync."""
         payload = json.dumps(dataclasses.asdict(self), indent=2)
         directory = os.path.dirname(os.path.abspath(path))
         os.makedirs(directory, exist_ok=True)
@@ -52,7 +52,14 @@ class LoaderCheckpoint:
         try:
             with os.fdopen(fd, "w") as f:
                 f.write(payload)
+                f.flush()
+                os.fsync(f.fileno())
             os.replace(tmp_path, path)
+            dir_fd = os.open(directory, os.O_RDONLY)
+            try:
+                os.fsync(dir_fd)
+            finally:
+                os.close(dir_fd)
         except BaseException:
             if os.path.exists(tmp_path):
                 os.remove(tmp_path)
@@ -87,12 +94,23 @@ def resume_iterator(dataset,
     comes back for the next one, so a crash while processing batch N
     replays batch N on resume — batches can repeat across a crash, but
     none are ever skipped.
+
+    After the final epoch the checkpoint is left at
+    ``(epoch=num_epochs, batches_consumed=0)``, so resuming a finished run
+    is a clean no-op instead of a silent extra epoch.
+
+    Skipping is cheap when the dataset supports
+    ``set_epoch(epoch, skip_batches=N)`` (ours do): already-consumed
+    batches are dropped as zero-copy Arrow slices before any
+    NumPy conversion or device transfer happens. Foreign datasets fall
+    back to materialize-and-discard.
     """
-    if getattr(dataset, "batch_size", checkpoint.batch_size) != \
-            checkpoint.batch_size:
-        raise ValueError(
-            f"dataset batch_size {dataset.batch_size} != checkpoint "
-            f"{checkpoint.batch_size}")
+    for field in ("batch_size", "seed", "num_epochs"):
+        have = getattr(dataset, field, None)
+        want = getattr(checkpoint, field)
+        if have is not None and have != want:
+            raise ValueError(
+                f"dataset {field} {have} != checkpoint {field} {want}")
 
     def _maybe_save():
         if checkpoint_path is not None:
@@ -101,8 +119,16 @@ def resume_iterator(dataset,
     for epoch in range(checkpoint.epoch, checkpoint.num_epochs):
         skip = checkpoint.batches_consumed if epoch == checkpoint.epoch else 0
         checkpoint.epoch = epoch
-        dataset.set_epoch(epoch)
-        index = 0
+        fallback_skip = 0
+        if skip:
+            try:
+                dataset.set_epoch(epoch, skip_batches=skip)
+            except TypeError:  # foreign dataset: discard batches ourselves
+                dataset.set_epoch(epoch)
+                fallback_skip = skip
+        else:
+            dataset.set_epoch(epoch)
+        index = skip - fallback_skip
         for batch in dataset:
             index += 1
             if index <= skip:
@@ -112,6 +138,5 @@ def resume_iterator(dataset,
             if checkpoint_every and index % checkpoint_every == 0:
                 _maybe_save()
         checkpoint.batches_consumed = 0
-        if epoch + 1 < checkpoint.num_epochs:
-            checkpoint.epoch = epoch + 1
+        checkpoint.epoch = epoch + 1
         _maybe_save()

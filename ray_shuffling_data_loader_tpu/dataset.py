@@ -161,6 +161,8 @@ class ShufflingDataset:
         self._num_epochs = num_epochs
         self._num_trainers = num_trainers
         self._rank = rank
+        self._seed = seed
+        self._skip_batches = 0
         self._epoch: Optional[int] = None
         # Guards against iterating without a fresh set_epoch
         # (reference: dataset.py:143-168).
@@ -171,14 +173,42 @@ class ShufflingDataset:
     def batch_size(self) -> int:
         return self._batch_size
 
-    def set_epoch(self, epoch: int) -> None:
+    @property
+    def seed(self) -> int:
+        return self._seed
+
+    @property
+    def num_epochs(self) -> int:
+        return self._num_epochs
+
+    @property
+    def num_trainers(self) -> int:
+        return self._num_trainers
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def start_epoch(self) -> int:
+        return self._start_epoch
+
+    def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
         """Declare the epoch about to be iterated. Must be called before
-        each epoch's iteration (reference: dataset.py:147-157)."""
+        each epoch's iteration (reference: dataset.py:147-157).
+
+        ``skip_batches`` drops the first N batches of the epoch as zero-copy
+        Arrow slices — the cheap path for checkpoint resume (the rows are
+        still shuffled/fetched, but never converted or transferred).
+        """
         if epoch < self._start_epoch:
             raise ValueError(
                 f"epoch {epoch} precedes start_epoch {self._start_epoch}; "
                 "epochs before the resume point are never shuffled and "
                 "iterating them would block forever")
+        if skip_batches < 0:
+            raise ValueError(f"skip_batches must be >= 0, got {skip_batches}")
+        self._skip_batches = skip_batches
         self._epoch = epoch
 
     def __iter__(self) -> Iterator[pa.Table]:
@@ -189,6 +219,8 @@ class ShufflingDataset:
                 "dataset (e.g. via enumerate(ds)).")
 
         batch_size = self._batch_size
+        to_skip = self._skip_batches * batch_size  # rows, not batches
+        self._skip_batches = 0
         queue_idx = self._epoch * self._num_trainers + self._rank
         # Leftover carry buffer: tables whose total rows < batch_size
         # (reference keeps a DataFrame buffer, dataset.py:170-202; we keep a
@@ -200,6 +232,12 @@ class ShufflingDataset:
             if ref is None:
                 break
             table: pa.Table = ref.result()
+            if to_skip:
+                if table.num_rows <= to_skip:
+                    to_skip -= table.num_rows
+                    continue
+                table = table.slice(to_skip)
+                to_skip = 0
             offset = 0
             num_rows = table.num_rows
             # Top up the carry buffer to a full batch first.

@@ -205,12 +205,20 @@ class JaxShufflingDataset:
         self._device_put = device_put
         self.batch_wait_stats = BatchWaitStats()
 
-    def set_epoch(self, epoch: int) -> None:
-        self._dataset.set_epoch(epoch)
+    def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
+        self._dataset.set_epoch(epoch, skip_batches=skip_batches)
 
     @property
     def batch_size(self) -> int:
         return self._dataset.batch_size
+
+    @property
+    def seed(self) -> int:
+        return self._dataset.seed
+
+    @property
+    def num_epochs(self) -> int:
+        return self._dataset.num_epochs
 
     def _sharding(self, ndim: int):
         from jax.sharding import NamedSharding, PartitionSpec as P

@@ -1,0 +1,273 @@
+"""Tagged TCP byte transport for cross-host shuffle traffic (the DCN plane).
+
+The reference moves map->reduce chunks between nodes through Ray's plasma
+object store and raylet-to-raylet object transfer (C++, external — SURVEY.md
+§2.3, reference: shuffle.py:185-186). On a TPU slice that data plane is the
+host network / DCN, and nothing external provides it, so this module is the
+framework's own transport: one listener per host, persistent peer
+connections, length-prefixed frames tagged ``(epoch, reducer, file_index)``,
+and a blocking tag-matched receive. Payloads are raw bytes (the shuffle
+sends Arrow IPC streams); ``socket.sendall``/``recv`` release the GIL so
+large transfers overlap with map/reduce compute threads.
+
+Wire format per message, all little-endian:
+
+    magic   u32 = 0x5244534C ("RSDL")
+    src     u32   sending host id
+    epoch   u64
+    reducer u64
+    file    u64
+    length  u64   payload byte count
+    payload length bytes
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+_MAGIC = 0x5244534C
+_HEADER = struct.Struct("<IIQQQQ")
+
+Tag = Tuple[int, int, int]  # (epoch, reducer_index, file_index)
+
+
+class TransportError(RuntimeError):
+    pass
+
+
+class TransportTimeout(TransportError):
+    pass
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    """Read exactly n bytes or raise TransportError on EOF."""
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise TransportError("peer closed connection mid-message")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks) if len(chunks) != 1 else chunks[0]
+
+
+class TcpTransport:
+    """Point-to-point tagged message transport between shuffle hosts.
+
+    Args:
+        host_id: this host's index in ``addresses``.
+        addresses: ``(hostname, port)`` per host, identical on every host.
+
+    ``start()`` binds the listener; ``connect()`` dials every peer (call on
+    all hosts after all have started — the dial retries with backoff to
+    absorb startup skew, the same role as the reference's named-actor
+    connect retry, reference: multiqueue.py:310-332).
+    """
+
+    def __init__(self, host_id: int, addresses: Sequence[Tuple[str, int]],
+                 recv_timeout_s: float = 600.0):
+        if not 0 <= host_id < len(addresses):
+            raise ValueError(
+                f"host_id {host_id} out of range for {len(addresses)} hosts")
+        self.host_id = host_id
+        self.addresses = list(addresses)
+        self.world = len(addresses)
+        self._recv_timeout_s = recv_timeout_s
+        self._inbox: Dict[Tuple[int, Tag], bytes] = {}
+        self._inbox_cv = threading.Condition()
+        self._peers: Dict[int, socket.socket] = {}
+        self._peer_locks: Dict[int, threading.Lock] = {}
+        self._listener: Optional[socket.socket] = None
+        self._accept_threads: List[threading.Thread] = []
+        self._closed = threading.Event()
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> None:
+        """Bind the listener and start accepting peer connections."""
+        host, port = self.addresses[self.host_id]
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind((host, port))
+        listener.listen(self.world)
+        self._listener = listener
+        thread = threading.Thread(target=self._accept_loop, daemon=True,
+                                  name=f"rsdl-transport-accept-{self.host_id}")
+        thread.start()
+        self._accept_threads.append(thread)
+
+    def bound_port(self) -> int:
+        """The actual listening port (useful when configured with port 0)."""
+        assert self._listener is not None, "start() first"
+        return self._listener.getsockname()[1]
+
+    def connect(self, retries: int = 30,
+                initial_backoff_s: float = 0.1) -> None:
+        """Dial every remote peer, retrying to absorb startup skew."""
+        import time
+        for peer in range(self.world):
+            if peer == self.host_id:
+                continue
+            host, port = self.addresses[peer]
+            backoff = initial_backoff_s
+            last_err: Optional[Exception] = None
+            for attempt in range(retries + 1):
+                try:
+                    sock = socket.create_connection((host, port), timeout=30)
+                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                    self._peers[peer] = sock
+                    self._peer_locks[peer] = threading.Lock()
+                    last_err = None
+                    break
+                except OSError as e:
+                    last_err = e
+                    time.sleep(backoff)
+                    backoff = min(backoff * 2, 5.0)
+            if last_err is not None:
+                raise TransportError(
+                    f"host {self.host_id} could not reach peer {peer} at "
+                    f"{host}:{port}: {last_err}")
+        logger.info("host %d connected to %d peers", self.host_id,
+                    self.world - 1)
+
+    def close(self) -> None:
+        self._closed.set()
+        with self._inbox_cv:
+            self._inbox_cv.notify_all()
+        for sock in self._peers.values():
+            try:
+                sock.close()
+            except OSError:
+                pass
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+
+    def __enter__(self) -> "TcpTransport":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+    # -- receive path --------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        assert self._listener is not None
+        while not self._closed.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return  # listener closed
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            thread = threading.Thread(target=self._recv_loop, args=(conn,),
+                                      daemon=True,
+                                      name=f"rsdl-transport-recv-{self.host_id}")
+            thread.start()
+            self._accept_threads.append(thread)
+
+    def _recv_loop(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                header = _recv_exact(conn, _HEADER.size)
+                magic, src, epoch, reducer, file_index, length = (
+                    _HEADER.unpack(header))
+                if magic != _MAGIC:
+                    raise TransportError(
+                        f"bad magic {magic:#x} from peer (protocol mismatch)")
+                payload = _recv_exact(conn, length)
+                key = (src, (epoch, reducer, file_index))
+                with self._inbox_cv:
+                    if key in self._inbox:
+                        raise TransportError(f"duplicate message for {key}")
+                    self._inbox[key] = payload
+                    self._inbox_cv.notify_all()
+        except TransportError:
+            if not self._closed.is_set():
+                logger.info("host %d: peer connection ended", self.host_id)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def recv(self, src: int, tag: Tag,
+             timeout_s: Optional[float] = None) -> bytes:
+        """Block until the message with ``tag`` from host ``src`` arrives.
+
+        Each message is consumed exactly once. Raises TransportTimeout after
+        ``timeout_s`` (default: the transport-wide ``recv_timeout_s``) so a
+        dead peer fails the trial loudly instead of hanging it.
+        """
+        if timeout_s is None:
+            timeout_s = self._recv_timeout_s
+        key = (src, tag)
+        import time
+        deadline = time.monotonic() + timeout_s
+        with self._inbox_cv:
+            while key not in self._inbox:
+                if self._closed.is_set():
+                    raise TransportError("transport closed while receiving")
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    raise TransportTimeout(
+                        f"host {self.host_id}: no message {tag} from host "
+                        f"{src} within {timeout_s:.0f}s")
+                self._inbox_cv.wait(timeout=min(remaining, 1.0))
+            return self._inbox.pop(key)
+
+    # -- send path -----------------------------------------------------------
+
+    def send(self, dest: int, tag: Tag, payload: bytes) -> None:
+        """Send ``payload`` to host ``dest`` tagged ``tag``. Thread-safe."""
+        if dest == self.host_id:
+            key = (self.host_id, tag)
+            with self._inbox_cv:
+                if key in self._inbox:
+                    raise TransportError(f"duplicate message for {key}")
+                self._inbox[key] = payload
+                self._inbox_cv.notify_all()
+            return
+        sock = self._peers.get(dest)
+        if sock is None:
+            raise TransportError(
+                f"host {self.host_id} has no connection to peer {dest} "
+                "(connect() not called or peer unreachable)")
+        epoch, reducer, file_index = tag
+        header = _HEADER.pack(_MAGIC, self.host_id, epoch, reducer,
+                              file_index, len(payload))
+        with self._peer_locks[dest]:
+            try:
+                sock.sendall(header)
+                sock.sendall(payload)
+            except OSError as e:
+                raise TransportError(
+                    f"host {self.host_id} failed sending to peer {dest}: {e}")
+
+
+def create_local_transports(world: int,
+                            recv_timeout_s: float = 600.0
+                            ) -> List[TcpTransport]:
+    """A fully-connected ``world`` of transports on localhost ephemeral
+    ports — the single-machine stand-in for a TPU slice's host network,
+    used by tests and the multi-host simulation example."""
+    transports = [
+        TcpTransport(h, [("127.0.0.1", 0)] * world,
+                     recv_timeout_s=recv_timeout_s) for h in range(world)
+    ]
+    for t in transports:
+        t.start()
+    addresses = [("127.0.0.1", t.bound_port()) for t in transports]
+    for t in transports:
+        t.addresses = addresses
+        t.connect()
+    return transports

@@ -1,0 +1,62 @@
+"""Subprocess worker for the multi-process distributed-shuffle test.
+
+One real OS process per simulated TPU-VM host: builds the TCP transport,
+runs the distributed shuffle driver, consumes its trainer's batches through
+the real ShufflingDataset path, and writes the per-epoch key sequences to a
+JSON file for the parent test to verify.
+
+Usage: python distributed_worker.py <host_id> <world> <ports_csv>
+       <data_dir> <num_epochs> <num_reducers> <batch_size> <out_dir>
+"""
+
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset  # noqa: E402
+from ray_shuffling_data_loader_tpu.parallel.distributed import (  # noqa: E402
+    create_distributed_batch_queue_and_shuffle)
+from ray_shuffling_data_loader_tpu.parallel.transport import TcpTransport  # noqa: E402
+
+
+def main() -> None:
+    (host_id, world, ports_csv, data_dir, num_epochs, num_reducers,
+     batch_size, out_dir) = sys.argv[1:9]
+    host_id, world = int(host_id), int(world)
+    num_epochs, num_reducers = int(num_epochs), int(num_reducers)
+    batch_size = int(batch_size)
+    addresses = [("127.0.0.1", int(p)) for p in ports_csv.split(",")]
+    filenames = sorted(
+        glob.glob(os.path.join(data_dir, "*.parquet.snappy")),
+        key=lambda p: int(p.rsplit("_", 1)[1].split(".")[0]))
+
+    transport = TcpTransport(host_id, addresses, recv_timeout_s=60.0)
+    transport.start()
+    transport.connect()
+    try:
+        batch_queue, shuffle_result = (
+            create_distributed_batch_queue_and_shuffle(
+                filenames, num_epochs, num_reducers, transport,
+                max_concurrent_epochs=2, seed=7))
+        ds = ShufflingDataset(
+            filenames, num_epochs, num_trainers=1, batch_size=batch_size,
+            rank=0, batch_queue=batch_queue, shuffle_result=shuffle_result)
+        epochs = {}
+        for epoch in range(num_epochs):
+            ds.set_epoch(epoch)
+            keys = []
+            for table in ds:
+                keys.extend(table.column("key").to_pylist())
+            epochs[str(epoch)] = keys
+    finally:
+        transport.close()
+
+    with open(os.path.join(out_dir, f"host{host_id}.json"), "w") as f:
+        json.dump(epochs, f)
+
+
+if __name__ == "__main__":
+    main()

@@ -88,11 +88,48 @@ def test_resume_persists_progress(tmp_path):
     # At-least-once: the save for batch N lands when the caller returns
     # for batch N+1, so after two next() calls batch 1 is durably recorded.
     assert saved.epoch == 0 and saved.batches_consumed == 1
-    # Drain; at the end the checkpoint points past the final epoch's work.
+    # Drain; a finished run's checkpoint points past ALL epochs so a
+    # restart after completion is a no-op, not a replay of the last epoch.
     for _ in it:
         pass
     saved = ckpt.LoaderCheckpoint.load(path)
-    assert saved.epoch == 1 and saved.batches_consumed == 0
+    assert saved.epoch == 2 and saved.batches_consumed == 0
+
+
+def test_resume_of_finished_run_is_noop():
+    c = make_checkpoint(epoch=3, num_epochs=3)  # finished
+
+    class Boom:
+        batch_size = 20
+
+        def set_epoch(self, *a, **k):
+            raise AssertionError("finished checkpoint must not iterate")
+
+    assert list(ckpt.resume_iterator(Boom(), c)) == []
+
+
+def test_seed_and_num_epochs_mismatch_rejected(tmp_path):
+    filenames = write_files(tmp_path, num_files=2, rows_per_file=40)
+    d = ds.ShufflingDataset(filenames, num_epochs=3, num_trainers=1,
+                            batch_size=20, rank=0, num_reducers=2, seed=11,
+                            queue_name="seed-mismatch")
+    with pytest.raises(ValueError, match="seed"):
+        next(ckpt.resume_iterator(d, make_checkpoint(seed=12)))
+    with pytest.raises(ValueError, match="num_epochs"):
+        next(ckpt.resume_iterator(d, make_checkpoint(num_epochs=4)))
+    d.shutdown()
+
+
+def test_skip_batches_matches_full_iteration(tmp_path):
+    """set_epoch(skip_batches=N) drops exactly the first N batches."""
+    filenames = write_files(tmp_path, num_files=3, rows_per_file=60)
+    full = _run_full(filenames, 7, 1, 20, "skip-full")
+    d = ds.ShufflingDataset(filenames, num_epochs=1, num_trainers=1,
+                            batch_size=20, rank=0, num_reducers=3, seed=7,
+                            queue_name="skip-run")
+    d.set_epoch(0, skip_batches=4)
+    got = [b.column("key").to_pylist() for b in d]
+    assert got == full[0][4:]
 
 
 def test_batch_size_mismatch_rejected(tmp_path):
