@@ -124,7 +124,6 @@ def main(argv=None):
             mesh, lambda p, s, y: dlrm.loss_fn(cfg, p, None, s, y),
             params, optax.adam(args.learning_rate))
 
-    from ray_shuffling_data_loader_tpu.utils.config import default_num_reducers
     sorted_files = sorted(filenames)
     dataset_kwargs = dict(
         num_epochs=args.num_epochs, num_trainers=1,
@@ -150,10 +149,15 @@ def main(argv=None):
         transport = TcpTransport(rank, addresses)
         transport.start()
         transport.connect()
+        # The ShardPlan (hence every send/recv tag) is a function of
+        # num_reducers, so the value must be identical on every host.
+        # default_num_reducers() depends on the *local* cpu count, which can
+        # differ across hosts — derive the default from world only.
+        num_reducers = args.num_reducers or 8 * world
         batch_queue, shuffle_result = (
             create_distributed_batch_queue_and_shuffle(
                 sorted_files, args.num_epochs,
-                args.num_reducers or default_num_reducers(world), transport,
+                num_reducers, transport,
                 max_concurrent_epochs=args.max_concurrent_epochs,
                 seed=args.seed, queue_name=dataset_kwargs["queue_name"]))
         ds = JaxShufflingDataset(

@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Format/lint gate (reference: format.sh — yapf 0.23.0 + flake8 3.7.7 over
+# changed files). Uses yapf/flake8 when installed; always runs a bytecode
+# compile check so the gate works on TPU-VM images without lint tools.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+PY_DIRS=(ray_shuffling_data_loader_tpu tests benchmarks examples)
+
+echo "-- compile check"
+python -m compileall -q "${PY_DIRS[@]}" bench.py __graft_entry__.py setup.py
+
+if python -c 'import yapf' 2>/dev/null; then
+    echo "-- yapf (diff mode)"
+    python -m yapf --style .style.yapf --recursive --diff "${PY_DIRS[@]}"
+else
+    echo "-- yapf not installed, skipping"
+fi
+
+if python -c 'import flake8' 2>/dev/null; then
+    echo "-- flake8"
+    python -m flake8 "${PY_DIRS[@]}"
+else
+    echo "-- flake8 not installed, skipping"
+fi
+
+echo "OK"

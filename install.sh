@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Editable install + native kernel prebuild (reference: install.sh).
+# On a TPU-VM image, jax/flax/optax and pyarrow are preinstalled; this only
+# registers the package and warms the C++ kernel cache.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+pip install -e .
+python -c "from ray_shuffling_data_loader_tpu import native; \
+print('native kernels:', 'loaded' if native.available() else 'NumPy fallback')"

@@ -24,9 +24,15 @@ from typing import Iterator, List, Optional, Sequence
 
 import pyarrow as pa
 
+import importlib
+
 from ray_shuffling_data_loader_tpu import executor as ex
 from ray_shuffling_data_loader_tpu import multiqueue as mq
-from ray_shuffling_data_loader_tpu import shuffle as sh
+
+# Not ``from ray_shuffling_data_loader_tpu import shuffle``: the package
+# __init__ rebinds that attribute to the shuffle() function, so attribute
+# import resolves differently under ``python -m`` than under package import.
+sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
 from ray_shuffling_data_loader_tpu.utils.config import default_num_reducers
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
@@ -279,3 +285,49 @@ class ShufflingDataset:
         if self._owns_queue:
             self._batch_queue.shutdown()
             self._owns_queue = False
+
+
+if __name__ == "__main__":
+    # Smoke driver (reference: dataset.py:233-276): generate synthetic rows
+    # locally, run a few epochs through the full pipeline, count batches.
+    import argparse
+    import tempfile
+    import timeit
+
+    from ray_shuffling_data_loader_tpu import data_generation as dg
+
+    parser = argparse.ArgumentParser(description="ShufflingDataset smoke run")
+    parser.add_argument("--num-rows", type=int, default=10**6)
+    parser.add_argument("--num-files", type=int, default=10)
+    parser.add_argument("--num-epochs", type=int, default=4)
+    parser.add_argument("--num-reducers", type=int, default=8)
+    parser.add_argument("--batch-size", type=int, default=50_000)
+    parser.add_argument("--max-concurrent-epochs", type=int, default=2)
+    args = parser.parse_args()
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        print(f"Generating {args.num_rows} rows over {args.num_files} files.")
+        filenames, _ = dg.generate_data_local(args.num_rows, args.num_files,
+                                              1, 0.0, tmpdir)
+        print(f"Starting {args.num_epochs}-epoch consumption, "
+              f"{args.num_reducers} reducers, 1 trainer.")
+        start = timeit.default_timer()
+        ds = ShufflingDataset(filenames,
+                              args.num_epochs,
+                              num_trainers=1,
+                              batch_size=args.batch_size,
+                              rank=0,
+                              num_reducers=args.num_reducers,
+                              max_concurrent_epochs=args.max_concurrent_epochs)
+        for epoch in range(args.num_epochs):
+            ds.set_epoch(epoch)
+            rows = batches = 0
+            for batch in ds:
+                batches += 1
+                rows += batch.num_rows
+            assert rows == args.num_rows, (rows, args.num_rows)
+            print(f"epoch {epoch}: {batches} batches, {rows} rows")
+        duration = timeit.default_timer() - start
+        total = args.num_epochs * args.num_rows
+        print(f"Done: {total} rows in {duration:.2f}s "
+              f"({total / duration:,.0f} rows/s)")

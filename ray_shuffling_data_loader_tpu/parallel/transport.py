@@ -83,6 +83,7 @@ class TcpTransport:
         self._recv_timeout_s = recv_timeout_s
         self._inbox: Dict[Tuple[int, Tag], bytes] = {}
         self._inbox_cv = threading.Condition()
+        self._dead_srcs: Dict[int, str] = {}  # src host id -> reason
         self._peers: Dict[int, socket.socket] = {}
         self._peer_locks: Dict[int, threading.Lock] = {}
         self._listener: Optional[socket.socket] = None
@@ -122,6 +123,10 @@ class TcpTransport:
             for attempt in range(retries + 1):
                 try:
                     sock = socket.create_connection((host, port), timeout=30)
+                    # Drop the dial timeout: a timed-out sendall after a
+                    # partial write would corrupt the framed stream. Blocking
+                    # sends + the receiver-side recv timeout handle dead peers.
+                    sock.settimeout(None)
                     sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
                     self._peers[peer] = sock
                     self._peer_locks[peer] = threading.Lock()
@@ -176,14 +181,21 @@ class TcpTransport:
             self._accept_threads.append(thread)
 
     def _recv_loop(self, conn: socket.socket) -> None:
+        srcs_seen: set = set()
         try:
             while not self._closed.is_set():
-                header = _recv_exact(conn, _HEADER.size)
+                first = conn.recv(_HEADER.size)
+                if not first:
+                    return  # clean close at a message boundary
+                header = (first if len(first) == _HEADER.size else
+                          first + _recv_exact(conn,
+                                              _HEADER.size - len(first)))
                 magic, src, epoch, reducer, file_index, length = (
                     _HEADER.unpack(header))
                 if magic != _MAGIC:
                     raise TransportError(
                         f"bad magic {magic:#x} from peer (protocol mismatch)")
+                srcs_seen.add(src)
                 payload = _recv_exact(conn, length)
                 key = (src, (epoch, reducer, file_index))
                 with self._inbox_cv:
@@ -191,9 +203,16 @@ class TcpTransport:
                         raise TransportError(f"duplicate message for {key}")
                     self._inbox[key] = payload
                     self._inbox_cv.notify_all()
-        except TransportError:
+        except (TransportError, OSError) as e:
             if not self._closed.is_set():
-                logger.info("host %d: peer connection ended", self.host_id)
+                # Fail pending/future recvs from these srcs fast instead of
+                # letting them sit out the full recv timeout.
+                with self._inbox_cv:
+                    for src in srcs_seen:
+                        self._dead_srcs.setdefault(src, str(e))
+                    self._inbox_cv.notify_all()
+                logger.warning("host %d: peer connection died: %s",
+                               self.host_id, e)
         finally:
             try:
                 conn.close()
@@ -217,6 +236,11 @@ class TcpTransport:
             while key not in self._inbox:
                 if self._closed.is_set():
                     raise TransportError("transport closed while receiving")
+                if src in self._dead_srcs:
+                    raise TransportError(
+                        f"host {self.host_id}: connection from host {src} "
+                        f"died before message {tag} arrived: "
+                        f"{self._dead_srcs[src]}")
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TransportTimeout(
@@ -227,8 +251,9 @@ class TcpTransport:
 
     # -- send path -----------------------------------------------------------
 
-    def send(self, dest: int, tag: Tag, payload: bytes) -> None:
-        """Send ``payload`` to host ``dest`` tagged ``tag``. Thread-safe."""
+    def send(self, dest: int, tag: Tag, payload) -> None:
+        """Send ``payload`` (any buffer-protocol object, e.g. bytes or a
+        ``pyarrow.Buffer``) to host ``dest`` tagged ``tag``. Thread-safe."""
         if dest == self.host_id:
             key = (self.host_id, tag)
             with self._inbox_cv:
@@ -244,7 +269,7 @@ class TcpTransport:
                 "(connect() not called or peer unreachable)")
         epoch, reducer, file_index = tag
         header = _HEADER.pack(_MAGIC, self.host_id, epoch, reducer,
-                              file_index, len(payload))
+                              file_index, memoryview(payload).nbytes)
         with self._peer_locks[dest]:
             try:
                 sock.sendall(header)

@@ -164,3 +164,53 @@ class TorchShufflingDataset(IterableDataset):
     def __iter__(self):
         for table in self._dataset:
             yield convert_to_tensor(table, *self._spec)
+
+
+if __name__ == "__main__":
+    # Smoke driver through the Torch path with the full DATA_SPEC column
+    # spec (reference: torch_dataset.py:241-310).
+    import argparse
+    import tempfile
+    import timeit
+
+    from ray_shuffling_data_loader_tpu import data_generation as dg
+
+    parser = argparse.ArgumentParser(
+        description="TorchShufflingDataset smoke run")
+    parser.add_argument("--num-rows", type=int, default=10**6)
+    parser.add_argument("--num-files", type=int, default=10)
+    parser.add_argument("--num-epochs", type=int, default=4)
+    parser.add_argument("--num-reducers", type=int, default=8)
+    parser.add_argument("--batch-size", type=int, default=50_000)
+    args = parser.parse_args()
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        print(f"Generating {args.num_rows} rows over {args.num_files} files.")
+        filenames, _ = dg.generate_data_local(args.num_rows, args.num_files,
+                                              1, 0.0, tmpdir)
+        feature_columns = list(dg.FEATURE_COLUMNS)
+        start = timeit.default_timer()
+        ds = TorchShufflingDataset(
+            filenames,
+            args.num_epochs,
+            num_trainers=1,
+            batch_size=args.batch_size,
+            rank=0,
+            num_reducers=args.num_reducers,
+            feature_columns=feature_columns,
+            feature_types=[torch.long] * len(feature_columns),
+            label_column=dg.LABEL_COLUMN,
+            label_type=torch.double)
+        for epoch in range(args.num_epochs):
+            ds.set_epoch(epoch)
+            rows = batches = 0
+            for features, label in ds:
+                assert len(features) == len(feature_columns)
+                batches += 1
+                rows += label.shape[0]
+            assert rows == args.num_rows, (rows, args.num_rows)
+            print(f"epoch {epoch}: {batches} batches, {rows} rows")
+        duration = timeit.default_timer() - start
+        total = args.num_epochs * args.num_rows
+        print(f"Done: {total} rows in {duration:.2f}s "
+              f"({total / duration:,.0f} rows/s)")

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Developer environment setup (reference: setup.sh). Installs the package
+# plus the optional dev tools the format gate uses when present.
+set -euo pipefail
+cd "$(dirname "$0")"
+
+bash install.sh
+pip install pytest yapf flake8 2>/dev/null || \
+    echo "dev tools unavailable (offline image) — format.sh degrades gracefully"
