@@ -125,10 +125,16 @@ def _layer_norm(x, scale, bias, eps=1e-12):
 
 def apply(config: BertConfig, params: Dict[str, Any],
           token_ids: jax.Array,
-          attention_mask: jax.Array = None) -> jax.Array:
+          attention_mask: jax.Array = None,
+          attention_fn=None) -> jax.Array:
     """token_ids (B, S) int32 -> logits (B, S, vocab).
 
     ``attention_mask`` (B, S) with 1 = attend, 0 = padding; None = all 1.
+
+    ``attention_fn(q, k, v, bias) -> (B, H, S, D)`` swaps the attention
+    implementation — e.g. ``ops.ring_attention.make_attention_fn(mesh,
+    seq_axis)`` for sequence-parallel long-context runs, or the Pallas
+    flash kernel. None = inline full attention on the MXU.
     """
     dtype = config.compute_dtype
     b, s = token_ids.shape
@@ -139,7 +145,7 @@ def apply(config: BertConfig, params: Dict[str, Any],
     x = _layer_norm(x, params["emb_ln"]["scale"], params["emb_ln"]["bias"])
 
     if attention_mask is None:
-        bias = jnp.zeros((b, 1, 1, s), jnp.float32)
+        bias = None  # no mask: skip the zero-add (and any SP bias rotation)
     else:
         bias = jnp.where(attention_mask[:, None, None, :] > 0, 0.0,
                          -1e9).astype(jnp.float32)
@@ -151,10 +157,15 @@ def apply(config: BertConfig, params: Dict[str, Any],
         q = q.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
         k = k.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
         v = v.reshape(b, s, nh, hd).transpose(0, 2, 1, 3)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
-        scores = scores / jnp.sqrt(hd) + bias
-        weights = jax.nn.softmax(scores, axis=-1).astype(dtype)
-        attended = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
+        if attention_fn is not None:
+            attended = attention_fn(q, k, v, bias)
+        else:
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32)
+            scores = scores / jnp.sqrt(hd)
+            if bias is not None:
+                scores = scores + bias
+            weights = jax.nn.softmax(scores, axis=-1).astype(dtype)
+            attended = jnp.einsum("bhqk,bhkd->bhqd", weights, v)
         attended = attended.transpose(0, 2, 1, 3).reshape(b, s, h)
         attn_out = (attended @ lp["attn_out_w"].astype(dtype)
                     + lp["attn_out_b"].astype(dtype))
@@ -172,9 +183,10 @@ def apply(config: BertConfig, params: Dict[str, Any],
 
 def loss_fn(config: BertConfig, params: Dict[str, Any],
             token_ids: jax.Array, mlm_targets: jax.Array,
-            attention_mask: jax.Array = None) -> jax.Array:
+            attention_mask: jax.Array = None,
+            attention_fn=None) -> jax.Array:
     """Masked-LM cross-entropy over positions where targets != IGNORE_ID."""
-    logits = apply(config, params, token_ids, attention_mask)
+    logits = apply(config, params, token_ids, attention_mask, attention_fn)
     mask = (mlm_targets != IGNORE_ID)
     safe_targets = jnp.where(mask, mlm_targets, 0).astype(jnp.int32)
     logp = jax.nn.log_softmax(logits, axis=-1)

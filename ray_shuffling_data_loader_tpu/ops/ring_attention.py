@@ -1,0 +1,234 @@
+"""Sequence/context-parallel attention: ring attention and Ulysses.
+
+The reference has no sequence axis at all (SURVEY.md §2.4/§5: tabular rows;
+its BERT workload batches pre-tokenized fixed-length rows) — long-context
+scaling is a capability the TPU build adds, designed mesh-first rather than
+ported: both strategies are pure XLA collectives (``ppermute`` /
+``all_to_all``) inside ``shard_map`` over a sequence mesh axis, so they run
+over ICI on a slice and over DCN across slices with no custom kernels or
+NCCL-style backend.
+
+Two interchangeable strategies, both computing exact (not approximate)
+softmax attention for sequences sharded along a mesh axis:
+
+- :func:`ring_self_attention` — blockwise online-softmax attention; K/V
+  (and the key-side bias) rotate around the ring one hop per step, so no
+  device ever materializes the full sequence or the full score matrix.
+  Memory per device: O(S/n · S/n) scores, O(S/n) K/V. The classic ring
+  attention construction (Liu et al., 2023), built from ``jax.lax.ppermute``.
+- :func:`ulysses_attention` — all-to-all head parallelism (DeepSpeed
+  Ulysses): one ``all_to_all`` reshards from sequence-sharded to
+  head-sharded, each device runs full-sequence attention for H/n heads,
+  and a second ``all_to_all`` reshards back. Cheaper collectives for
+  moderate S (2 all-to-alls vs n-1 ppermutes) but requires n | H and
+  materializes full-length K/V per device.
+
+Numerics: scores and the softmax accumulators are float32 regardless of
+input dtype (bf16 in the models); masking uses a large finite negative so
+fully-masked rows stay NaN-free.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+NEG_INF = -1e9  # finite, like models/bert.py — keeps softmax NaN-free
+_ACC_MIN = -1e30
+
+
+def _block_attention(q, k, v, bias, m, l, o):
+    """One online-softmax accumulation step against a K/V block.
+
+    q: (B, H, Sq, D) f32 (pre-scaled); k/v: (B, H, Sk, D); bias
+    broadcastable to (B, H, Sq, Sk) f32. Carries m (running max), l
+    (running denominator) of shape (B, H, Sq) and o (B, H, Sq, D), all f32.
+    """
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k.astype(jnp.float32))
+    if bias is not None:
+        scores = scores + bias
+    m_new = jnp.maximum(m, scores.max(axis=-1))
+    corr = jnp.exp(m - m_new)
+    p = jnp.exp(scores - m_new[..., None])
+    l_new = l * corr + p.sum(axis=-1)
+    o_new = o * corr[..., None] + jnp.einsum(
+        "bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return m_new, l_new, o_new
+
+
+def _ring_attention_shard(q, k, v, kv_bias, axis_name: str, causal: bool):
+    """Per-shard ring attention body; must run under shard_map/pmap.
+
+    q/k/v: (B, H, S_local, D) — this device's sequence chunk. kv_bias:
+    (B, 1, 1, S_local) additive key-side bias or None. K/V (+bias) rotate
+    around ``axis_name``; the local chunk's global offset is recovered from
+    the ring step, which is what makes the causal mask correct.
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    sq, sk = q.shape[2], k.shape[2]
+    d = q.shape[-1]
+    qf = q.astype(jnp.float32) * (1.0 / jnp.sqrt(d).astype(jnp.float32))
+    m0 = jnp.full(q.shape[:3], _ACC_MIN, jnp.float32)
+    l0 = jnp.zeros(q.shape[:3], jnp.float32)
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    has_bias = kv_bias is not None  # static: shapes the loop carry
+
+    def step(i, carry):
+        k_c, v_c, bias_c, m, l, o = carry
+        # After i rotations this device holds chunk (my - i) mod n.
+        src = (my - i) % n
+        bias = bias_c
+        if causal:
+            q_pos = my * sq + jnp.arange(sq)
+            k_pos = src * sk + jnp.arange(sk)
+            causal_bias = jnp.where(
+                q_pos[:, None] >= k_pos[None, :], 0.0,
+                NEG_INF)[None, None, :, :]
+            bias = causal_bias if bias is None else bias + causal_bias
+        m, l, o = _block_attention(qf, k_c, v_c, bias, m, l, o)
+        # One hop: send our current chunk to the next device on the ring.
+        # (The final iteration's hop returns chunks to their owners — one
+        # redundant ppermute, kept so the loop body is collective-uniform.)
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        if has_bias:
+            bias_c = jax.lax.ppermute(bias_c, axis_name, perm)
+        return k_c, v_c, bias_c, m, l, o
+
+    bias0 = kv_bias.astype(jnp.float32) if has_bias else None
+    _, _, _, m, l, o = jax.lax.fori_loop(
+        0, n, step, (k, v, bias0, m0, l0, o0))
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+
+
+def _dispatch_sharded(shard_fn, q, k, v, bias, mesh: Mesh, seq_axis: str,
+                      batch_axis: Optional[str]):
+    """shard_map a per-shard attention body with the standard specs:
+    q/k/v sequence-sharded on dim 2, bias (if any) on its key dim 3."""
+    qkv_spec = P(batch_axis, None, seq_axis, None)
+    bias_spec = P(batch_axis, None, None, seq_axis)
+    if bias is None:
+        fn = jax.shard_map(lambda q_, k_, v_: shard_fn(q_, k_, v_, None),
+                           mesh=mesh, in_specs=(qkv_spec,) * 3,
+                           out_specs=qkv_spec, check_vma=False)
+        return fn(q, k, v)
+    fn = jax.shard_map(shard_fn, mesh=mesh,
+                       in_specs=(qkv_spec,) * 3 + (bias_spec,),
+                       out_specs=qkv_spec, check_vma=False)
+    return fn(q, k, v, bias)
+
+
+def ring_self_attention(q: jax.Array,
+                        k: jax.Array,
+                        v: jax.Array,
+                        mesh: Mesh,
+                        seq_axis: str,
+                        bias: Optional[jax.Array] = None,
+                        batch_axis: Optional[str] = None,
+                        causal: bool = False) -> jax.Array:
+    """Exact attention over a sequence sharded on ``mesh[seq_axis]``.
+
+    Args:
+        q, k, v: (B, H, S, D) with S (globally) sharded over ``seq_axis``
+            and optionally B over ``batch_axis``.
+        bias: optional additive key-side bias (B, 1, 1, S) (e.g. padding
+            mask as 0 / NEG_INF), sharded like the K sequence axis.
+        causal: apply a causal mask using global positions.
+
+    Returns (B, H, S, D), sharded like ``q``.
+    """
+    shard_fn = functools.partial(_ring_attention_shard, axis_name=seq_axis,
+                                 causal=causal)
+    return _dispatch_sharded(shard_fn, q, k, v, bias, mesh, seq_axis,
+                             batch_axis)
+
+
+def _full_attention(q, k, v, bias):
+    """Plain full-sequence attention (f32 softmax), used per Ulysses shard
+    and as the reference implementation in tests."""
+    d = q.shape[-1]
+    qf = q.astype(jnp.float32) * (1.0 / jnp.sqrt(d).astype(jnp.float32))
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qf, k.astype(jnp.float32))
+    if bias is not None:
+        scores = scores + bias.astype(jnp.float32)
+    weights = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bhkd->bhqd", weights,
+                     v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def _ulysses_shard(q, k, v, kv_bias, axis_name: str, causal: bool):
+    """Per-shard Ulysses body: seq-sharded -> head-sharded -> back."""
+    # (B, H, S/n, D) -> (B, H/n, S, D): scatter heads, gather sequence.
+    q = jax.lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    k = jax.lax.all_to_all(k, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    v = jax.lax.all_to_all(v, axis_name, split_axis=1, concat_axis=2,
+                           tiled=True)
+    bias = None
+    if kv_bias is not None:
+        # Key-side bias has no head dim to scatter — gather the full-length
+        # bias on every device instead.
+        bias = jax.lax.all_gather(kv_bias, axis_name, axis=3, tiled=True)
+    if causal:
+        s = q.shape[2]
+        pos = jnp.arange(s)
+        causal_bias = jnp.where(pos[:, None] >= pos[None, :], 0.0, NEG_INF)
+        causal_bias = causal_bias[None, None, :, :]
+        bias = causal_bias if bias is None else bias + causal_bias
+    out = _full_attention(q, k, v, bias)
+    # (B, H/n, S, D) -> (B, H, S/n, D): back to sequence-sharded.
+    return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+
+def ulysses_attention(q: jax.Array,
+                      k: jax.Array,
+                      v: jax.Array,
+                      mesh: Mesh,
+                      seq_axis: str,
+                      bias: Optional[jax.Array] = None,
+                      batch_axis: Optional[str] = None,
+                      causal: bool = False) -> jax.Array:
+    """DeepSpeed-Ulysses-style all-to-all sequence parallelism.
+
+    Same contract as :func:`ring_self_attention`; additionally requires the
+    head count be divisible by the ``seq_axis`` size.
+    """
+    n = mesh.shape[seq_axis]
+    if q.shape[1] % n != 0:
+        raise ValueError(
+            f"ulysses_attention needs num_heads ({q.shape[1]}) divisible by "
+            f"mesh axis '{seq_axis}' size ({n})")
+    shard_fn = functools.partial(_ulysses_shard, axis_name=seq_axis,
+                                 causal=causal)
+    return _dispatch_sharded(shard_fn, q, k, v, bias, mesh, seq_axis,
+                             batch_axis)
+
+
+def make_attention_fn(mesh: Mesh,
+                      seq_axis: str,
+                      strategy: str = "ring",
+                      batch_axis: Optional[str] = None,
+                      causal: bool = False):
+    """An ``attention_fn(q, k, v, bias) -> out`` closure for models/bert.py's
+    pluggable attention, bound to a mesh and strategy ("ring" | "ulysses")."""
+    if strategy == "ring":
+        impl = ring_self_attention
+    elif strategy == "ulysses":
+        impl = ulysses_attention
+    else:
+        raise ValueError(f"unknown strategy {strategy!r}")
+
+    def attention_fn(q, k, v, bias=None):
+        return impl(q, k, v, mesh, seq_axis, bias=bias,
+                    batch_axis=batch_axis, causal=causal)
+
+    return attention_fn
