@@ -1,0 +1,414 @@
+"""Pallas TPU flash attention: blockwise online-softmax on the MXU.
+
+The hot op of the BERT-MLM family (BASELINE.json config 4). The inline
+attention in models/bert.py materializes the full (B, H, S, S) score
+matrix in HBM; these kernels stream K/V *blocks* through VMEM (one block
+per grid step — VMEM residency is O(block·D), independent of S) with the
+online-softmax recurrence, so scores never leave VMEM and HBM traffic
+drops from O(S²) to O(S·D) — the usual flash-attention win, written as
+Pallas kernels per /opt/skills/guides/pallas_guide.md (grid over
+(batch, head, q-block, k-block) with the K dimension innermost; running
+max / denominator / accumulator live in VMEM scratch that persists across
+the K iterations; the output block is written on the last K step).
+
+Training is blockwise end-to-end: the forward kernel also emits the
+per-query logsumexp, and the backward pass is two more Pallas kernels
+(dq: grid over q-blocks streaming K; dk/dv(+dbias): grid over k-blocks
+streaming Q) using the standard flash-attention backward identities —
+no O(S²) tensor is ever materialized in either direction. Wrapped in a
+``jax.custom_vjp``.
+
+Mosaic requires (8, 128)-aligned tiles, so on TPU the sequence dims are
+padded up to aligned block multiples (padded keys masked with a large
+negative, padded query rows sliced off) rather than silently shrinking
+blocks to degenerate sizes. When there is no bias and no padding, the
+kernels compile without any bias machinery.
+
+On CPU (tests, the 8-device virtual mesh) the same kernels run under the
+Pallas interpreter; ``make_flash_attention_fn`` picks interpret mode
+automatically so the op is portable. Composes with models/bert.py via the
+``attention_fn`` hook, like ops/ring_attention.py's sequence-parallel
+strategies (flash = single-device long-S; ring = cross-device sharded-S).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG = -1e30   # accumulator init
+_MASK = -1e9   # padded-key bias (finite, matches ring_attention.NEG_INF)
+
+
+def _dot(a, b, dims):
+    return jax.lax.dot_general(a, b, (dims, ((), ())),
+                               preferred_element_type=jnp.float32)
+
+
+def _vmem(shape, dtype):
+    from jax.experimental.pallas import tpu as pltpu
+    return pltpu.VMEM(shape, dtype)
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+# -- kernels ----------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, *, scale: float):
+    """Grid (B, H, num_q, num_k), K innermost. Blocks: q/o (1,1,bq,D);
+    k/v (1,1,bk,D); bias (1,1,1,bk) or absent; lse (1,1,bq,1). Scratch
+    m/l (bq,1), acc (bq,D) persist across the K iterations of one
+    q-block."""
+    j = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, _NEG)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32) * scale      # (bq, D)
+    k = k_ref[0, 0].astype(jnp.float32)              # (bk, D)
+    v = v_ref[0, 0].astype(jnp.float32)
+    s = _dot(q, k, ((1,), (1,)))                     # (bq, bk)
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0, 0][None, :]
+    m_prev, l_prev = m_scr[:], l_scr[:]
+    m_new = jnp.maximum(m_prev, s.max(axis=-1, keepdims=True))
+    corr = jnp.exp(m_prev - m_new)
+    p = jnp.exp(s - m_new)
+    m_scr[:] = m_new
+    l_scr[:] = l_prev * corr + p.sum(axis=-1, keepdims=True)
+    acc_scr[:] = acc_scr[:] * corr + _dot(p, v, ((1,), (0,)))
+
+    @pl.when(j == num_k - 1)
+    def _finish():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0, 0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        lse_ref[0, 0] = m_scr[:] + jnp.log(l)
+
+
+def _fwd_kernel_nobias(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                       m_scr, l_scr, acc_scr, *, scale: float):
+    _fwd_kernel(q_ref, k_ref, v_ref, None, o_ref, lse_ref,
+                m_scr, l_scr, acc_scr, scale=scale)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr, *, scale: float):
+    """Grid (B, H, num_q, num_k), K innermost: dq for one q-block."""
+    j = pl.program_id(3)
+    num_k = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    s = _dot(q, k, ((1,), (1,))) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0, 0][None, :]
+    p = jnp.exp(s - lse_ref[0, 0])                   # softmax weights
+    dp = _dot(do, v, ((1,), (1,)))                   # (bq, bk)
+    ds = p * (dp - delta_ref[0, 0])
+    dq_scr[:] = dq_scr[:] + _dot(ds, k, ((1,), (0,))) * scale
+
+    @pl.when(j == num_k - 1)
+    def _finish():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dq_kernel_nobias(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                      dq_ref, dq_scr, *, scale: float):
+    _dq_kernel(q_ref, k_ref, v_ref, None, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr, scale=scale)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, bias_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, dbias_ref, dk_scr, dv_scr, dbias_scr, *,
+                scale: float):
+    """Grid (B, H, num_k, num_q), Q innermost: dk/dv/dbias for one
+    k-block. dbias is emitted per-head (summed over heads by the caller)."""
+    j = pl.program_id(3)
+    num_q = pl.num_programs(3)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+        if dbias_scr is not None:
+            dbias_scr[:] = jnp.zeros_like(dbias_scr)
+
+    q = q_ref[0, 0].astype(jnp.float32)
+    k = k_ref[0, 0].astype(jnp.float32)
+    v = v_ref[0, 0].astype(jnp.float32)
+    do = do_ref[0, 0].astype(jnp.float32)
+    s = _dot(q, k, ((1,), (1,))) * scale
+    if bias_ref is not None:
+        s = s + bias_ref[0, 0, 0][None, :]
+    p = jnp.exp(s - lse_ref[0, 0])                   # (bq, bk)
+    dv_scr[:] = dv_scr[:] + _dot(p, do, ((0,), (0,)))
+    dp = _dot(do, v, ((1,), (1,)))
+    ds = p * (dp - delta_ref[0, 0])
+    dk_scr[:] = dk_scr[:] + _dot(ds, q, ((0,), (0,))) * scale
+    if dbias_scr is not None:
+        dbias_scr[:] = dbias_scr[:] + ds.sum(axis=0, keepdims=True)
+
+    @pl.when(j == num_q - 1)
+    def _finish():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+        if dbias_ref is not None:
+            dbias_ref[0, 0, 0] = dbias_scr[0]
+
+
+def _dkv_kernel_nobias(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                       dk_ref, dv_ref, dk_scr, dv_scr, *, scale: float):
+    _dkv_kernel(q_ref, k_ref, v_ref, None, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, None, dk_scr, dv_scr, None, scale=scale)
+
+
+# -- block planning & padding ----------------------------------------------
+
+
+def _pick_block(seq: int, preferred: int) -> int:
+    """Largest divisor of ``seq`` that is <= preferred (>= 1)."""
+    block = min(preferred, seq)
+    while seq % block:
+        block -= 1
+    return block
+
+
+def _plan(sq: int, sk: int, block_q: int, block_k: int, interpret: bool):
+    """(bq, bk, sq_pad, sk_pad). Interpret mode: any divisor works.
+    TPU: blocks must be (8, 128)-tile aligned, so pad the sequence dims
+    up to aligned block multiples instead of shrinking blocks."""
+    if interpret:
+        return (_pick_block(sq, block_q), _pick_block(sk, block_k), sq, sk)
+    bq = min(_round_up(block_q, 8), _round_up(sq, 8))
+    sq_pad = _round_up(sq, bq)
+    bk = min(max(_round_up(block_k, 128), 128), _round_up(sk, 128))
+    sk_pad = _round_up(sk, bk)
+    return bq, bk, sq_pad, sk_pad
+
+
+def _pad_dim2(x, target: int):
+    """Zero-pad (B, H, S, D) along S to ``target`` rows."""
+    if x.shape[2] == target:
+        return x
+    return jnp.pad(x, ((0, 0), (0, 0), (0, target - x.shape[2]), (0, 0)))
+
+
+def _prep_bias(bias, b: int, sk: int, sk_pad: int):
+    """Validated f32 bias padded to sk_pad (padded keys masked), or None
+    when there is neither a bias nor key padding."""
+    if bias is not None and bias.shape != (b, 1, 1, sk):
+        raise ValueError(
+            f"flash_attention bias must be key-side (B, 1, 1, S) = "
+            f"{(b, 1, 1, sk)}, got {bias.shape}; full (.., S, S) biases "
+            "(e.g. causal masks) are not supported by this kernel")
+    if bias is None and sk_pad == sk:
+        return None
+    base = (jnp.zeros((b, 1, 1, sk), jnp.float32) if bias is None
+            else bias.astype(jnp.float32))
+    if sk_pad != sk:
+        base = jnp.pad(base, ((0, 0), (0, 0), (0, 0), (0, sk_pad - sk)),
+                       constant_values=_MASK)
+    return base
+
+
+# -- forward / backward dispatch --------------------------------------------
+
+
+def _flash_forward(q, k, v, bias, block_q: int, block_k: int,
+                   interpret: bool):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk, sq_pad, sk_pad = _plan(sq, sk, block_q, block_k, interpret)
+    scale = 1.0 / (d ** 0.5)
+    bias_arr = _prep_bias(bias, b, sk, sk_pad)
+    qp = _pad_dim2(q, sq_pad)
+    kp, vp = _pad_dim2(k, sk_pad), _pad_dim2(v, sk_pad)
+    grid = (b, h, sq_pad // bq, sk_pad // bk)
+
+    in_specs = [
+        pl.BlockSpec((1, 1, bq, d), lambda i, j, g, t: (i, j, g, 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda i, j, g, t: (i, j, t, 0)),
+        pl.BlockSpec((1, 1, bk, d), lambda i, j, g, t: (i, j, t, 0)),
+    ]
+    args = [qp, kp, vp]
+    if bias_arr is not None:
+        in_specs.append(
+            pl.BlockSpec((1, 1, 1, bk), lambda i, j, g, t: (i, 0, 0, t)))
+        args.append(bias_arr)
+        kernel = functools.partial(_fwd_kernel, scale=scale)
+    else:
+        kernel = functools.partial(_fwd_kernel_nobias, scale=scale)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=in_specs,
+        out_specs=[
+            pl.BlockSpec((1, 1, bq, d), lambda i, j, g, t: (i, j, g, 0)),
+            pl.BlockSpec((1, 1, bq, 1), lambda i, j, g, t: (i, j, g, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(qp.shape, q.dtype),
+            jax.ShapeDtypeStruct((b, h, sq_pad, 1), jnp.float32),
+        ],
+        scratch_shapes=[
+            _vmem((bq, 1), jnp.float32),
+            _vmem((bq, 1), jnp.float32),
+            _vmem((bq, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*args)
+    if sq_pad != sq:
+        out, lse = out[:, :, :sq], lse[:, :, :sq]
+    return out, lse
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def flash_attention(q: jax.Array,
+                    k: jax.Array,
+                    v: jax.Array,
+                    bias: Optional[jax.Array] = None,
+                    block_q: int = 128,
+                    block_k: int = 128,
+                    interpret: bool = False) -> jax.Array:
+    """Exact attention via the Pallas flash kernels.
+
+    Args:
+        q, k, v: (B, H, S, D).
+        bias: optional additive key-side bias, strictly (B, 1, 1, S).
+        block_q/block_k: preferred VMEM tile sizes.
+        interpret: run under the Pallas interpreter (CPU tests).
+
+    Fully blockwise in both directions: neither forward nor backward
+    materializes an O(S²) tensor.
+    """
+    out, _ = _flash_forward(q, k, v, bias, block_q, block_k, interpret)
+    return out
+
+
+def _flash_fwd(q, k, v, bias, block_q, block_k, interpret):
+    out, lse = _flash_forward(q, k, v, bias, block_q, block_k, interpret)
+    return out, (q, k, v, bias, out, lse)
+
+
+def _flash_bwd(block_q, block_k, interpret, residuals, do):
+    q, k, v, bias, out, lse = residuals
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq, bk, sq_pad, sk_pad = _plan(sq, sk, block_q, block_k, interpret)
+    scale = 1.0 / (d ** 0.5)
+    bias_arr = _prep_bias(bias, b, sk, sk_pad)
+    # delta_i = sum_d do_i * o_i — the softmax-backward correction term.
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
+                    axis=-1, keepdims=True)  # (B, H, Sq, 1)
+    qp, dop = _pad_dim2(q, sq_pad), _pad_dim2(do, sq_pad)
+    kp, vp = _pad_dim2(k, sk_pad), _pad_dim2(v, sk_pad)
+    lsep, deltap = _pad_dim2(lse[..., None] if lse.ndim == 3 else lse,
+                             sq_pad), _pad_dim2(delta, sq_pad)
+    has_bias = bias_arr is not None
+
+    q_spec4 = pl.BlockSpec((1, 1, bq, d), lambda i, j, g, t: (i, j, g, 0))
+    k_spec4 = pl.BlockSpec((1, 1, bk, d), lambda i, j, g, t: (i, j, t, 0))
+    r_spec4 = pl.BlockSpec((1, 1, bq, 1), lambda i, j, g, t: (i, j, g, 0))
+    b_spec4 = pl.BlockSpec((1, 1, 1, bk), lambda i, j, g, t: (i, 0, 0, t))
+    in_specs = [q_spec4, k_spec4, k_spec4]
+    args = [qp, kp, vp]
+    if has_bias:
+        in_specs.append(b_spec4)
+        args.append(bias_arr)
+    in_specs += [q_spec4, r_spec4, r_spec4]
+    args += [dop, lsep, deltap]
+
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel if has_bias else _dq_kernel_nobias,
+                          scale=scale),
+        grid=(b, h, sq_pad // bq, sk_pad // bk),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, 1, bq, d),
+                               lambda i, j, g, t: (i, j, g, 0)),
+        out_shape=jax.ShapeDtypeStruct(qp.shape, q.dtype),
+        scratch_shapes=[_vmem((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(*args)
+    if sq_pad != sq:
+        dq = dq[:, :, :sq]
+
+    # Same inputs, but grid transposed: (B, H, num_k, num_q), Q innermost.
+    q_spec_t = pl.BlockSpec((1, 1, bq, d), lambda i, j, t, g: (i, j, g, 0))
+    k_spec_t = pl.BlockSpec((1, 1, bk, d), lambda i, j, t, g: (i, j, t, 0))
+    r_spec_t = pl.BlockSpec((1, 1, bq, 1), lambda i, j, t, g: (i, j, g, 0))
+    b_spec_t = pl.BlockSpec((1, 1, 1, bk), lambda i, j, t, g: (i, 0, 0, t))
+    in_specs_t = [q_spec_t, k_spec_t, k_spec_t]
+    if has_bias:
+        in_specs_t.append(b_spec_t)
+    in_specs_t += [q_spec_t, r_spec_t, r_spec_t]
+
+    out_specs = [k_spec_t, k_spec_t]
+    out_shape = [jax.ShapeDtypeStruct(kp.shape, k.dtype),
+                 jax.ShapeDtypeStruct(vp.shape, v.dtype)]
+    scratch = [_vmem((bk, d), jnp.float32), _vmem((bk, d), jnp.float32)]
+    if has_bias:
+        # Per-head dbias: indexed by the head grid dim, unlike the input
+        # bias (which broadcasts over heads from index 0).
+        out_specs.append(
+            pl.BlockSpec((1, 1, 1, bk), lambda i, j, t, g: (i, j, 0, t)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((b, h, 1, sk_pad), jnp.float32))
+        scratch.append(_vmem((1, bk), jnp.float32))
+
+    results = pl.pallas_call(
+        functools.partial(_dkv_kernel if has_bias else _dkv_kernel_nobias,
+                          scale=scale),
+        grid=(b, h, sk_pad // bk, sq_pad // bq),
+        in_specs=in_specs_t,
+        out_specs=out_specs,
+        out_shape=out_shape,
+        scratch_shapes=scratch,
+        interpret=interpret,
+    )(*args)
+    dk, dv = results[0], results[1]
+    if sk_pad != sk:
+        dk, dv = dk[:, :, :sk], dv[:, :, :sk]
+
+    dbias = None
+    if bias is not None:
+        dbias_h = results[2][:, :, :, :sk]
+        dbias = dbias_h.sum(axis=1, keepdims=True).astype(bias.dtype)
+    return dq, dk, dv, dbias
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+def make_flash_attention_fn(block_q: int = 128,
+                            block_k: int = 128,
+                            interpret: Optional[bool] = None):
+    """An ``attention_fn(q, k, v, bias)`` closure for models/bert.py.
+
+    ``interpret=None`` auto-selects the Pallas interpreter off-TPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() not in ("tpu", "axon")
+
+    def attention_fn(q, k, v, bias=None):
+        return flash_attention(q, k, v, bias, block_q, block_k, interpret)
+
+    return attention_fn

@@ -41,6 +41,12 @@ NEG_INF = -1e9  # finite, like models/bert.py — keeps softmax NaN-free
 _ACC_MIN = -1e30
 
 
+def causal_bias(q_pos: jax.Array, k_pos: jax.Array) -> jax.Array:
+    """(1, 1, Sq, Sk) additive causal mask from global position vectors."""
+    return jnp.where(q_pos[:, None] >= k_pos[None, :], 0.0,
+                     NEG_INF)[None, None, :, :].astype(jnp.float32)
+
+
 def _block_attention(q, k, v, bias, m, l, o):
     """One online-softmax accumulation step against a K/V block.
 
@@ -60,13 +66,10 @@ def _block_attention(q, k, v, bias, m, l, o):
     return m_new, l_new, o_new
 
 
-def _ring_attention_shard(q, k, v, kv_bias, axis_name: str, causal: bool):
-    """Per-shard ring attention body; must run under shard_map/pmap.
-
-    q/k/v: (B, H, S_local, D) — this device's sequence chunk. kv_bias:
-    (B, 1, 1, S_local) additive key-side bias or None. K/V (+bias) rotate
-    around ``axis_name``; the local chunk's global offset is recovered from
-    the ring step, which is what makes the causal mask correct.
+def _ring_attention_fwd_impl(axis_name: str, causal: bool, q, k, v, kv_bias):
+    """Forward ring pass; returns (out, lse) with lse = per-query
+    logsumexp (B, H, Sq) — the residual that makes the recompute-per-hop
+    backward pass possible without saving any per-step intermediate.
     """
     n = jax.lax.psum(1, axis_name)
     my = jax.lax.axis_index(axis_name)
@@ -83,15 +86,24 @@ def _ring_attention_shard(q, k, v, kv_bias, axis_name: str, causal: bool):
         k_c, v_c, bias_c, m, l, o = carry
         # After i rotations this device holds chunk (my - i) mod n.
         src = (my - i) % n
-        bias = bias_c
+
+        def accumulate(m, l, o):
+            bias = bias_c
+            if causal:
+                cb = causal_bias(my * sq + jnp.arange(sq),
+                                 src * sk + jnp.arange(sk))
+                bias = cb if bias is None else bias + cb
+            return _block_attention(qf, k_c, v_c, bias, m, l, o)
+
         if causal:
-            q_pos = my * sq + jnp.arange(sq)
-            k_pos = src * sk + jnp.arange(sk)
-            causal_bias = jnp.where(
-                q_pos[:, None] >= k_pos[None, :], 0.0,
-                NEG_INF)[None, None, :, :]
-            bias = causal_bias if bias is None else bias + causal_bias
-        m, l, o = _block_attention(qf, k_c, v_c, bias, m, l, o)
+            # Chunks strictly in this query chunk's future contribute
+            # nothing — skip their attention FLOPs entirely (about half
+            # the ring steps at large n). The ppermutes below still run
+            # every step, keeping the loop collective-uniform.
+            m, l, o = jax.lax.cond(src > my, lambda m, l, o: (m, l, o),
+                                   accumulate, m, l, o)
+        else:
+            m, l, o = accumulate(m, l, o)
         # One hop: send our current chunk to the next device on the ring.
         # (The final iteration's hop returns chunks to their owners — one
         # redundant ppermute, kept so the loop body is collective-uniform.)
@@ -104,7 +116,114 @@ def _ring_attention_shard(q, k, v, kv_bias, axis_name: str, causal: bool):
     bias0 = kv_bias.astype(jnp.float32) if has_bias else None
     _, _, _, m, l, o = jax.lax.fori_loop(
         0, n, step, (k, v, bias0, m0, l0, o0))
-    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
+    l_safe = jnp.maximum(l, 1e-30)
+    out = (o / l_safe[..., None]).astype(q.dtype)
+    lse = m + jnp.log(l_safe)
+    return out, lse
+
+
+def _ring_attention_bwd_impl(axis_name: str, causal: bool, q, k, v, kv_bias,
+                             out, lse, do):
+    """Backward ring pass (recompute per hop, standard flash identities).
+
+    dk/dv (and dbias) accumulators travel around the ring *with* their K/V
+    chunks: after the loop's n rotations every gradient chunk is back at
+    its owner. Per-step memory is O(Sq/n · Sk/n) — no residuals from the
+    forward other than (out, lse).
+    """
+    n = jax.lax.psum(1, axis_name)
+    my = jax.lax.axis_index(axis_name)
+    sq, sk = q.shape[2], k.shape[2]
+    d = q.shape[-1]
+    scale = 1.0 / jnp.sqrt(d).astype(jnp.float32)
+    qf = q.astype(jnp.float32)
+    dof = do.astype(jnp.float32)
+    # delta_i = sum_d do_i * out_i (softmax-backward correction).
+    delta = jnp.sum(dof * out.astype(jnp.float32), axis=-1)  # (B, H, Sq)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    has_bias = kv_bias is not None
+
+    def step(i, carry):
+        k_c, v_c, bias_c, dk_c, dv_c, dbias_c, dq = carry
+        src = (my - i) % n
+
+        def accumulate(dk_c, dv_c, dbias_c, dq):
+            kf, vf = k_c.astype(jnp.float32), v_c.astype(jnp.float32)
+            s = jnp.einsum("bhqd,bhkd->bhqk", qf, kf) * scale
+            if has_bias:
+                s = s + bias_c
+            if causal:
+                s = s + causal_bias(my * sq + jnp.arange(sq),
+                                    src * sk + jnp.arange(sk))[0]
+            p = jnp.exp(s - lse[..., None])  # recomputed softmax weights
+            dv_new = dv_c + jnp.einsum("bhqk,bhqd->bhkd", p, dof)
+            dp = jnp.einsum("bhqd,bhkd->bhqk", dof, vf)
+            ds = p * (dp - delta[..., None])
+            dq_new = dq + jnp.einsum("bhqk,bhkd->bhqd", ds, kf) * scale
+            dk_new = dk_c + jnp.einsum("bhqk,bhqd->bhkd", ds, qf) * scale
+            dbias_new = dbias_c
+            if has_bias:
+                dbias_new = dbias_c + ds.sum(axis=(1, 2))[:, None, None, :]
+            return dk_new, dv_new, dbias_new, dq_new
+
+        if causal:
+            dk_c, dv_c, dbias_c, dq = jax.lax.cond(
+                src > my, lambda a, b, c, e: (a, b, c, e), accumulate,
+                dk_c, dv_c, dbias_c, dq)
+        else:
+            dk_c, dv_c, dbias_c, dq = accumulate(dk_c, dv_c, dbias_c, dq)
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        dk_c = jax.lax.ppermute(dk_c, axis_name, perm)
+        dv_c = jax.lax.ppermute(dv_c, axis_name, perm)
+        if has_bias:
+            bias_c = jax.lax.ppermute(bias_c, axis_name, perm)
+            dbias_c = jax.lax.ppermute(dbias_c, axis_name, perm)
+        return k_c, v_c, bias_c, dk_c, dv_c, dbias_c, dq
+
+    bias0 = kv_bias.astype(jnp.float32) if has_bias else None
+    dbias0 = jnp.zeros((q.shape[0], 1, 1, sk), jnp.float32) if has_bias \
+        else None
+    carry0 = (k, v, bias0, jnp.zeros(k.shape, jnp.float32),
+              jnp.zeros(v.shape, jnp.float32), dbias0,
+              jnp.zeros(q.shape, jnp.float32))
+    _, _, _, dk, dv, dbias, dq = jax.lax.fori_loop(0, n, step, carry0)
+    dbias_out = dbias.astype(kv_bias.dtype) if has_bias else None
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias_out)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _ring_attention_prim(axis_name: str, causal: bool, q, k, v, kv_bias):
+    return _ring_attention_fwd_impl(axis_name, causal, q, k, v, kv_bias)[0]
+
+
+def _ring_prim_fwd(axis_name, causal, q, k, v, kv_bias):
+    out, lse = _ring_attention_fwd_impl(axis_name, causal, q, k, v, kv_bias)
+    return out, (q, k, v, kv_bias, out, lse)
+
+
+def _ring_prim_bwd(axis_name, causal, residuals, do):
+    q, k, v, kv_bias, out, lse = residuals
+    return _ring_attention_bwd_impl(axis_name, causal, q, k, v, kv_bias,
+                                    out, lse, do)
+
+
+_ring_attention_prim.defvjp(_ring_prim_fwd, _ring_prim_bwd)
+
+
+def _ring_attention_shard(q, k, v, kv_bias, axis_name: str, causal: bool):
+    """Per-shard ring attention body; must run under shard_map/pmap.
+
+    q/k/v: (B, H, S_local, D) — this device's sequence chunk. kv_bias:
+    (B, 1, 1, S_local) additive key-side bias or None. K/V (+bias) rotate
+    around ``axis_name``; the local chunk's global offset is recovered from
+    the ring step, which is what makes the causal mask correct.
+
+    Differentiable via a custom VJP that reruns the ring (recompute per
+    hop) instead of letting AD save every per-step O(S²/n²) intermediate.
+    """
+    return _ring_attention_prim(axis_name, causal, q, k, v, kv_bias)
 
 
 def _dispatch_sharded(shard_fn, q, k, v, bias, mesh: Mesh, seq_axis: str,
@@ -178,11 +297,9 @@ def _ulysses_shard(q, k, v, kv_bias, axis_name: str, causal: bool):
         # bias on every device instead.
         bias = jax.lax.all_gather(kv_bias, axis_name, axis=3, tiled=True)
     if causal:
-        s = q.shape[2]
-        pos = jnp.arange(s)
-        causal_bias = jnp.where(pos[:, None] >= pos[None, :], 0.0, NEG_INF)
-        causal_bias = causal_bias[None, None, :, :]
-        bias = causal_bias if bias is None else bias + causal_bias
+        pos = jnp.arange(q.shape[2])
+        cb = causal_bias(pos, pos)
+        bias = cb if bias is None else bias + cb
     out = _full_attention(q, k, v, bias)
     # (B, H/n, S, D) -> (B, H, S/n, D): back to sequence-sharded.
     return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,

@@ -1,0 +1,162 @@
+"""Pallas flash attention vs full attention (interpreter mode on CPU)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ray_shuffling_data_loader_tpu.models import bert
+from ray_shuffling_data_loader_tpu.ops import flash_attention as fa
+from ray_shuffling_data_loader_tpu.ops import ring_attention as ra
+
+B, H, S, D = 2, 4, 64, 16
+
+
+def _qkv(rng, s=S, dtype=jnp.float32):
+    return tuple(jnp.asarray(rng.standard_normal((B, H, s, D)), dtype)
+                 for _ in range(3))
+
+
+def test_flash_matches_full(rng):
+    q, k, v = _qkv(rng)
+    got = fa.flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    want = ra._full_attention(q, k, v, None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_with_bias(rng):
+    q, k, v = _qkv(rng)
+    mask = jnp.asarray(rng.integers(0, 2, (B, S)))
+    bias = jnp.where(mask[:, None, None, :] > 0, 0.0, ra.NEG_INF).astype(
+        jnp.float32)
+    got = fa.flash_attention(q, k, v, bias, block_q=16, block_k=16,
+                             interpret=True)
+    want = ra._full_attention(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_odd_sequence_autoshrinks_blocks(rng):
+    q, k, v = _qkv(rng, s=48)  # 48 not divisible by default 128
+    got = fa.flash_attention(q, k, v, interpret=True)
+    want = ra._full_attention(q, k, v, None)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_flash_gradients_match(rng):
+    q, k, v = _qkv(rng)
+    mask = jnp.asarray(rng.integers(0, 2, (B, S)))
+    bias = jnp.where(mask[:, None, None, :] > 0, 0.0, ra.NEG_INF).astype(
+        jnp.float32)
+
+    def flash_loss(q, k, v, bias):
+        return jnp.sum(fa.flash_attention(q, k, v, bias, 16, 16, True) ** 2)
+
+    def full_loss(q, k, v, bias):
+        return jnp.sum(ra._full_attention(q, k, v, bias) ** 2)
+
+    g_flash = jax.grad(flash_loss, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    g_full = jax.grad(full_loss, argnums=(0, 1, 2, 3))(q, k, v, bias)
+    for gf, gr in zip(g_flash, g_full):
+        np.testing.assert_allclose(np.asarray(gf), np.asarray(gr),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_flash_bf16_inputs(rng):
+    q, k, v = _qkv(rng, dtype=jnp.bfloat16)
+    got = fa.flash_attention(q, k, v, block_q=16, block_k=16, interpret=True)
+    want = ra._full_attention(q, k, v, None)
+    assert got.dtype == jnp.bfloat16
+    np.testing.assert_allclose(np.asarray(got, dtype=np.float32),
+                               np.asarray(want, dtype=np.float32),
+                               rtol=2e-2, atol=2e-2)
+
+
+def test_bert_with_flash_attention(rng):
+    config = bert.BertConfig(vocab_size=128, hidden_dim=32, num_layers=2,
+                             num_heads=4, ffn_dim=64, max_seq_len=S,
+                             compute_dtype=jnp.float32)
+    params = bert.init(config, jax.random.key(0))
+    token_ids = jnp.asarray(rng.integers(0, 128, (B, S)), jnp.int32)
+    attention_mask = jnp.asarray(rng.integers(0, 2, (B, S)), jnp.int32)
+    attention_fn = fa.make_flash_attention_fn(block_q=16, block_k=16)
+    want = bert.apply(config, params, token_ids, attention_mask)
+    got = bert.apply(config, params, token_ids, attention_mask,
+                     attention_fn=attention_fn)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_bert_flash_train_step_under_jit(rng):
+    """loss+grads through the flash kernel under jit stay finite/close."""
+    config = bert.BertConfig(vocab_size=64, hidden_dim=32, num_layers=1,
+                             num_heads=4, ffn_dim=64, max_seq_len=S,
+                             compute_dtype=jnp.float32)
+    params = bert.init(config, jax.random.key(1))
+    token_ids = jnp.asarray(rng.integers(0, 64, (B, S)), jnp.int32)
+    targets = jnp.where(jnp.asarray(rng.random((B, S)) < 0.15),
+                        token_ids, bert.IGNORE_ID)
+    attention_fn = fa.make_flash_attention_fn(block_q=16, block_k=16)
+
+    @jax.jit
+    def flash_step(p):
+        return jax.value_and_grad(
+            lambda p_: bert.loss_fn(config, p_, token_ids, targets,
+                                    attention_fn=attention_fn))(p)
+
+    loss_flash, grads_flash = flash_step(params)
+    loss_full, grads_full = jax.value_and_grad(
+        lambda p_: bert.loss_fn(config, p_, token_ids, targets))(params)
+    np.testing.assert_allclose(float(loss_flash), float(loss_full),
+                               rtol=1e-4)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-3, atol=5e-4),
+        grads_flash, grads_full)
+
+
+@pytest.mark.parametrize("seq,preferred,expect", [(64, 128, 64),
+                                                  (64, 16, 16),
+                                                  (48, 32, 24),
+                                                  (7, 128, 7)])
+def test_pick_block(seq, preferred, expect):
+    assert fa._pick_block(seq, preferred) == expect
+
+
+def test_rejects_non_keyside_bias(rng):
+    """A full (.., S, S) bias (e.g. a causal mask) must fail loudly, not
+    silently read row 0 for every query."""
+    q, k, v = _qkv(rng)
+    pos = jnp.arange(S)
+    causal = ra.causal_bias(pos, pos)  # (1, 1, S, S)
+    with pytest.raises(ValueError, match="key-side"):
+        fa.flash_attention(q, k, v, causal, 16, 16, True)
+
+
+@pytest.mark.parametrize("sq,sk,block_q,block_k,exp", [
+    (512, 512, 128, 128, (128, 128, 512, 512)),     # aligned, no padding
+    (127, 127, 128, 128, (128, 128, 128, 128)),     # prime S -> pad up
+    (48, 48, 16, 16, (16, 128, 48, 128)),           # small S, K padded
+    (520, 200, 128, 128, (128, 128, 640, 256)),     # both padded
+])
+def test_tpu_block_plan_is_tile_aligned(sq, sk, block_q, block_k, exp):
+    bq, bk, sq_pad, sk_pad = fa._plan(sq, sk, block_q, block_k,
+                                      interpret=False)
+    assert (bq, bk, sq_pad, sk_pad) == exp
+    assert bq % 8 == 0 and bk % 128 == 0
+    assert sq_pad % bq == 0 and sk_pad % bk == 0
+
+
+def test_prep_bias_masks_padded_keys(rng):
+    bias = jnp.zeros((2, 1, 1, 48), jnp.float32)
+    padded = fa._prep_bias(bias, 2, 48, 128)
+    assert padded.shape == (2, 1, 1, 128)
+    assert float(padded[..., :48].max()) == 0.0
+    assert float(padded[..., 48:].max()) == fa._MASK
+    # no bias + no padding -> stays None (fast path)
+    assert fa._prep_bias(None, 2, 48, 48) is None
+    # no bias + padding -> synthetic mask bias
+    synth = fa._prep_bias(None, 2, 48, 128)
+    assert synth is not None and float(synth[..., 48:].max()) == fa._MASK

@@ -39,11 +39,8 @@ def test_ring_matches_full_attention(rng, causal):
     mesh = _seq_mesh()
     got = ring_out = ra.ring_self_attention(q, k, v, mesh, "seq",
                                             causal=causal)
-    bias = None
-    if causal:
-        pos = jnp.arange(S)
-        bias = jnp.where(pos[:, None] >= pos[None, :], 0.0,
-                         ra.NEG_INF)[None, None, :, :]
+    pos = jnp.arange(S)
+    bias = ra.causal_bias(pos, pos) if causal else None
     want = ra._full_attention(q, k, v, bias)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
@@ -99,11 +96,8 @@ def test_ulysses_matches_full_attention(rng, causal):
     q, k, v = _qkv(rng)
     mesh = _seq_mesh()
     got = ra.ulysses_attention(q, k, v, mesh, "seq", causal=causal)
-    bias = None
-    if causal:
-        pos = jnp.arange(S)
-        bias = jnp.where(pos[:, None] >= pos[None, :], 0.0,
-                         ra.NEG_INF)[None, None, :, :]
+    pos = jnp.arange(S)
+    bias = ra.causal_bias(pos, pos) if causal else None
     want = ra._full_attention(q, k, v, bias)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
@@ -117,6 +111,53 @@ def test_ulysses_with_padding_bias(rng):
     want = ra._full_attention(q, k, v, bias)
     np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                rtol=1e-5, atol=1e-5)
+
+
+def test_ring_causal_gradients_match(rng):
+    """Backward through the causal skip-cond and the bias rotation.
+
+    Key 0 stays unpadded so every query has at least one causally-visible
+    live key — with all visible keys masked, attention is ill-defined and
+    implementations legitimately disagree on the degenerate rows.
+    """
+    q, k, v = _qkv(rng)
+    bias = _padding_bias(rng).at[:, :, :, 0].set(0.0)
+    mesh = _seq_mesh()
+    pos = jnp.arange(S)
+    full_bias = bias + ra.causal_bias(pos, pos)
+
+    def ring_loss(q, k, v, bias):
+        return jnp.sum(ra.ring_self_attention(
+            q, k, v, mesh, "seq", bias=bias, causal=True) ** 2)
+
+    def full_loss(q, k, v):
+        return jnp.sum(ra._full_attention(q, k, v, full_bias) ** 2)
+
+    g_ring = jax.grad(ring_loss, argnums=(0, 1, 2))(q, k, v, bias)
+    g_full = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_ring, g_full):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_gradients_match(rng):
+    """Reverse mode through the all_to_all pair and the bias all_gather."""
+    q, k, v = _qkv(rng)
+    bias = _padding_bias(rng)
+    mesh = _seq_mesh()
+
+    def ulysses_loss(q, k, v, bias):
+        return jnp.sum(
+            ra.ulysses_attention(q, k, v, mesh, "seq", bias=bias) ** 2)
+
+    def full_loss(q, k, v, bias):
+        return jnp.sum(ra._full_attention(q, k, v, bias) ** 2)
+
+    g_u = jax.grad(ulysses_loss, argnums=(0, 1, 2))(q, k, v, bias)
+    g_f = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v, bias)
+    for gu, gf in zip(g_u, g_f):
+        np.testing.assert_allclose(np.asarray(gu), np.asarray(gf),
+                                   rtol=1e-4, atol=1e-4)
 
 
 def test_ulysses_rejects_indivisible_heads(rng):
