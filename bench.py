@@ -111,11 +111,12 @@ def main() -> None:
         feature_types=[np.int32] * len(datagen.FEATURE_COLUMNS),
         label_column=datagen.LABEL_COLUMN,
         num_reducers=num_reducers, max_concurrent_epochs=2, seed=0,
-        queue_name="bench-queue", drop_last=True)
+        queue_name="bench-queue", drop_last=True, stack_features=True)
 
-    # Tiny jitted reduction per batch: forces every feature column to land
-    # on device; negligible compute.
-    touch = jax.jit(lambda fs, y: sum(f.sum() for f in fs) + y.sum())
+    # Tiny jitted reduction per batch: forces the batch to land on device;
+    # negligible compute. stack_features=True means ONE (batch, n_features)
+    # transfer per batch instead of one per column — the DLRM input layout.
+    touch = jax.jit(lambda f, y: f.sum() + y.sum())
 
     # Warm-up epoch 0 separately to exclude one-time compile cost (with a
     # single epoch there is no warm-up and compile time is included).

@@ -76,7 +76,8 @@ def create_batch_queue_and_shuffle(
         seed: int = 0,
         num_workers: Optional[int] = None,
         queue_name: str = MULTIQUEUE_NAME,
-        start_epoch: int = 0):
+        start_epoch: int = 0,
+        map_transform=None):
     """Driver-mode helper: create the queue and start the shuffle before any
     trainer exists, so every rank can be a pure consumer
     (reference: dataset.py:17-51)."""
@@ -101,7 +102,8 @@ def create_batch_queue_and_shuffle(
         seed=seed,
         num_workers=num_workers,
         collect_stats=False,
-        start_epoch=start_epoch)
+        start_epoch=start_epoch,
+        map_transform=map_transform)
     return batch_queue, shuffle_result
 
 
@@ -136,7 +138,8 @@ class ShufflingDataset:
                  seed: int = 0,
                  num_workers: Optional[int] = None,
                  queue_name: str = MULTIQUEUE_NAME,
-                 start_epoch: int = 0):
+                 start_epoch: int = 0,
+                 map_transform=None):
         if num_reducers is None:
             num_reducers = default_num_reducers(num_trainers)
         self._batch_size = batch_size
@@ -150,7 +153,8 @@ class ShufflingDataset:
                         max_concurrent_epochs, num_reducers,
                         max_batch_queue_size, seed=seed,
                         num_workers=num_workers, queue_name=queue_name,
-                        start_epoch=start_epoch))
+                        start_epoch=start_epoch,
+                        map_transform=map_transform))
                 self._owns_queue = True
             else:
                 self._batch_queue = mq.MultiQueue(

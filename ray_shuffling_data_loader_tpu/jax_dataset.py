@@ -114,6 +114,50 @@ def _column_to_numpy(column: pa.ChunkedArray, dtype: np.dtype) -> np.ndarray:
     return np.ascontiguousarray(arr.astype(dtype, copy=False))
 
 
+def make_cast_transform(feature_columns: Sequence[Any],
+                        feature_types: Sequence[np.dtype],
+                        label_column: Any,
+                        label_type: np.dtype):
+    """Map-time column-cast hook: cast spec'd numeric columns to their final
+    dtypes right after the Parquet read, BEFORE any shuffling.
+
+    The reference converts dtypes per batch on the trainer
+    (reference: torch_dataset.py:206-238); casting at the map stage instead
+    (e.g. int64 -> int32) halves the memory traffic of every downstream
+    stage — partition, permute-gather, re-batch, host->device DMA. Columns
+    that are not primitive numerics, are nullable, or are not in the spec
+    pass through untouched. The cast is an unchecked ``ndarray.astype``
+    (same semantics as the reference's ``torch.as_tensor(..., dtype=)``).
+    """
+    targets = {}
+    for col, dtype in zip(feature_columns, feature_types):
+        targets[col] = np.dtype(dtype)
+    targets[label_column] = np.dtype(label_type)
+
+    def transform(table: pa.Table) -> pa.Table:
+        columns = []
+        changed = False
+        for field in table.schema:
+            col = table.column(field.name)
+            target = targets.get(field.name)
+            if (target is not None and col.null_count == 0
+                    and (pa.types.is_integer(field.type)
+                         or pa.types.is_floating(field.type))
+                    and np.issubdtype(target, np.number)
+                    and pa.from_numpy_dtype(target) != field.type):
+                combined = (col.chunk(0) if col.num_chunks == 1
+                            else col.combine_chunks())
+                col = pa.array(
+                    combined.to_numpy(zero_copy_only=False).astype(target))
+                changed = True
+            columns.append(col)
+        if not changed:
+            return table
+        return pa.table(columns, names=table.column_names)
+
+    return transform
+
+
 def convert_to_arrays(table: pa.Table,
                       feature_columns: List[Any],
                       feature_shapes: List[Optional[Tuple[int, ...]]],
@@ -158,6 +202,16 @@ class JaxShufflingDataset:
         drop_last: fixed shapes are strongly recommended on TPU (a ragged
             tail batch triggers one extra XLA compile), so this defaults to
             True — unlike the reference.
+        stack_features: yield features as ONE ``(batch, num_features)``
+            device array instead of a list of ``(batch, 1)`` arrays.
+            Requires identical feature dtypes and scalar/1-wide shapes.
+            One host->device transfer per batch instead of one per column —
+            this is the layout DLRM-style models consume anyway.
+        cast_at_map: cast spec'd columns to their final dtypes at the map
+            stage (before shuffling) instead of per batch — see
+            :func:`make_cast_transform`. Only effective when this dataset
+            launches the shuffle (rank 0 without an external
+            ``batch_queue``).
     """
 
     def __init__(self,
@@ -185,7 +239,30 @@ class JaxShufflingDataset:
                  data_axis: str = "data",
                  prefetch_size: int = 2,
                  device_put: bool = True,
-                 start_epoch: int = 0):
+                 start_epoch: int = 0,
+                 stack_features: bool = False,
+                 cast_at_map: bool = True):
+        (self._feature_columns, self._feature_shapes, self._feature_types,
+         self._label_column, self._label_shape, self._label_type) = (
+             _normalize_jax_data_spec(feature_columns, feature_shapes,
+                                      feature_types, label_column,
+                                      label_shape, label_type))
+        if stack_features:
+            if len(set(self._feature_types)) != 1:
+                raise ValueError(
+                    "stack_features requires identical feature dtypes, got "
+                    f"{self._feature_types}")
+            for shape in self._feature_shapes:
+                if shape is not None and tuple(shape) != (1,):
+                    raise ValueError(
+                        "stack_features requires scalar (or (1,)-shaped) "
+                        f"feature columns, got shape {shape}")
+        self._stack_features = stack_features
+        map_transform = None
+        if cast_at_map and label_column is not None:
+            map_transform = make_cast_transform(
+                self._feature_columns, self._feature_types,
+                self._label_column, self._label_type)
         self._dataset = ShufflingDataset(
             filenames, num_epochs, num_trainers, batch_size, rank,
             drop_last=drop_last, num_reducers=num_reducers,
@@ -193,12 +270,7 @@ class JaxShufflingDataset:
             batch_queue=batch_queue, shuffle_result=shuffle_result,
             max_batch_queue_size=max_batch_queue_size, seed=seed,
             num_workers=num_workers, queue_name=queue_name,
-            start_epoch=start_epoch)
-        (self._feature_columns, self._feature_shapes, self._feature_types,
-         self._label_column, self._label_shape, self._label_type) = (
-             _normalize_jax_data_spec(feature_columns, feature_shapes,
-                                      feature_types, label_column,
-                                      label_shape, label_type))
+            start_epoch=start_epoch, map_transform=map_transform)
         self._mesh = mesh
         self._data_axis = data_axis
         self._prefetch_size = max(1, prefetch_size)
@@ -233,17 +305,25 @@ class JaxShufflingDataset:
         features, label = arrays_label
         if not self._device_put:
             return features, label
-        out_features = [
-            jax.device_put(a, self._sharding(a.ndim)) for a in features
-        ]
+        if isinstance(features, np.ndarray):  # stacked
+            out_features = jax.device_put(features,
+                                          self._sharding(features.ndim))
+        else:
+            out_features = [
+                jax.device_put(a, self._sharding(a.ndim)) for a in features
+            ]
         out_label = jax.device_put(label, self._sharding(label.ndim))
         return out_features, out_label
 
     def _convert(self, table: pa.Table):
-        return convert_to_arrays(
+        features, label = convert_to_arrays(
             table, self._feature_columns, self._feature_shapes,
             self._feature_types, self._label_column, self._label_shape,
             self._label_type)
+        if self._stack_features:
+            features = (features[0] if len(features) == 1
+                        else np.concatenate(features, axis=1))
+        return features, label
 
     def __iter__(self) -> Iterator[Tuple[List[Any], Any]]:
         """Yield ``(features, label)`` device batches.

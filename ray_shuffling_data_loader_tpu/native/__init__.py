@@ -48,6 +48,11 @@ def _bind(lib: ctypes.CDLL) -> None:
                                   ctypes.POINTER(ctypes.c_double))
     lib.rsdl_partition_indices.argtypes = [u32p, i64, i64, i64p, i64p]
     lib.rsdl_partition_indices.restype = ctypes.c_int
+    lib.rsdl_scatter_gather.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+        i64, ctypes.c_int32, ctypes.c_int
+    ]
+    lib.rsdl_scatter_gather.restype = ctypes.c_int
     lib.rsdl_fill_random_int64.argtypes = [i64p, i64, i64, u64, ctypes.c_int]
     lib.rsdl_fill_random_int64.restype = None
     lib.rsdl_fill_random_double.argtypes = [f64p, i64, u64, ctypes.c_int]
@@ -133,6 +138,33 @@ def partition_indices(assignments: np.ndarray,
         raise ValueError(
             f"assignment value out of range for num_reducers={num_reducers}")
     return [out[offsets[r]:offsets[r + 1]] for r in range(num_reducers)]
+
+
+def scatter_gather(src: np.ndarray, idx: Optional[np.ndarray],
+                   dest: np.ndarray, out: np.ndarray,
+                   nthreads: int = 1) -> None:
+    """Fused ``out[dest] = src[idx]`` (``src[i]`` when ``idx`` is None) in
+    one memory pass — NumPy's fancy-index form gathers into a temporary
+    then scatters it. ``dest`` entries must be unique; ``idx``/``dest``
+    must be int32; ``src``/``out`` must share a 1/2/4/8-byte dtype.
+    """
+    lib = _load()
+    assert lib is not None
+    n = len(dest)
+    if idx is not None:
+        assert idx.dtype == np.int32 and idx.flags.c_contiguous
+        assert len(idx) == n
+    assert dest.dtype == np.int32 and dest.flags.c_contiguous
+    assert src.flags.c_contiguous and out.flags.c_contiguous
+    assert src.dtype.itemsize == out.dtype.itemsize
+    rc = lib.rsdl_scatter_gather(
+        src.ctypes.data, 0 if idx is None else idx.ctypes.data,
+        dest.ctypes.data, out.ctypes.data, n, src.dtype.itemsize,
+        nthreads)
+    if rc != 0:
+        raise ValueError(
+            f"unsupported element size {src.dtype.itemsize} for "
+            "native scatter_gather")
 
 
 def fill_random_int64(n: int, bound: int, seed: int,

@@ -54,6 +54,68 @@ int rsdl_partition_indices(const uint32_t* assignments, int64_t n,
 }
 
 // ---------------------------------------------------------------------------
+// Fused scatter-gather: out[dest[i]] = src[idx[i]]
+// ---------------------------------------------------------------------------
+
+// The reduce stage's permute is the shuffle's hottest loop. NumPy evaluates
+// out[dest] = src[idx] as a gather into a temporary followed by a scatter
+// (two memory passes + an allocation); this kernel is the single fused pass.
+// idx == nullptr means "src is already in order" (out[dest[i]] = src[i]).
+// dest entries must be unique (they are a slice of a permutation), so
+// threads writing disjoint i-ranges never race.
+}  // extern "C" (template helper below needs C++ linkage)
+
+template <typename T>
+static void scatter_gather_typed(const T* src, const int32_t* idx,
+                                 const int32_t* dest, T* out, int64_t n,
+                                 int nthreads) {
+  auto work = [&](int64_t lo, int64_t hi) {
+    if (idx == nullptr) {
+      for (int64_t i = lo; i < hi; ++i) out[dest[i]] = src[i];
+    } else {
+      for (int64_t i = lo; i < hi; ++i) out[dest[i]] = src[idx[i]];
+    }
+  };
+  if (nthreads <= 1 || n < (1 << 16)) {
+    work(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  threads.reserve(nthreads);
+  for (int t = 0; t < nthreads; ++t)
+    threads.emplace_back(work, n * t / nthreads, n * (t + 1) / nthreads);
+  for (auto& th : threads) th.join();
+}
+
+extern "C" {
+
+// elem_size must be 1, 2, 4, or 8; returns -1 otherwise, 0 on success.
+int rsdl_scatter_gather(const void* src, const int32_t* idx,
+                        const int32_t* dest, void* out, int64_t n,
+                        int32_t elem_size, int nthreads) {
+  switch (elem_size) {
+    case 1:
+      scatter_gather_typed(static_cast<const uint8_t*>(src), idx, dest,
+                           static_cast<uint8_t*>(out), n, nthreads);
+      return 0;
+    case 2:
+      scatter_gather_typed(static_cast<const uint16_t*>(src), idx, dest,
+                           static_cast<uint16_t*>(out), n, nthreads);
+      return 0;
+    case 4:
+      scatter_gather_typed(static_cast<const uint32_t*>(src), idx, dest,
+                           static_cast<uint32_t*>(out), n, nthreads);
+      return 0;
+    case 8:
+      scatter_gather_typed(static_cast<const uint64_t*>(src), idx, dest,
+                           static_cast<uint64_t*>(out), n, nthreads);
+      return 0;
+    default:
+      return -1;
+  }
+}
+
+// ---------------------------------------------------------------------------
 // Threaded random fill (xoshiro256**) for synthetic data generation
 // ---------------------------------------------------------------------------
 

@@ -113,25 +113,28 @@ class ShardPlan:
 
 def _map_task(filename: str, global_file_index: int, num_reducers: int,
               seed: int, epoch: int, plan: ShardPlan,
-              transport: TcpTransport,
-              stats_collector) -> Dict[int, pa.Table]:
+              transport: TcpTransport, stats_collector,
+              map_transform=None,
+              file_cache=None) -> Dict[int, "sh.LazyChunk"]:
     """Map one local file, ship remote reducers' chunks, keep local ones.
 
-    Remote chunks leave immediately (sendall releases the GIL) so the
-    host-local return value holds only this host's reducer chunks — the
-    distributed analog of Ray's per-slice multi-return fetch
+    Remote chunks are materialized (gathered) only to cross the wire and
+    leave immediately (sendall releases the GIL); host-local chunks stay
+    lazy index arrays so the local reduce can run its single fused gather —
+    the distributed analog of Ray's per-slice multi-return fetch
     (reference: shuffle.py:174-176).
     """
-    parts = sh.shuffle_map(filename, num_reducers, seed, epoch,
-                           global_file_index, stats_collector)
-    local: Dict[int, pa.Table] = {}
-    for reducer_index, part in enumerate(parts):
+    shard = sh.shuffle_map(filename, num_reducers, seed, epoch,
+                           global_file_index, stats_collector, map_transform,
+                           file_cache)
+    local: Dict[int, sh.LazyChunk] = {}
+    for reducer_index, chunk in enumerate(shard):
         owner = plan.reducer_host(reducer_index)
         if owner == transport.host_id:
-            local[reducer_index] = part
+            local[reducer_index] = chunk
         else:
             transport.send(owner, (epoch, reducer_index, global_file_index),
-                           serialize_table(part))
+                           serialize_table(chunk.materialize()))
     return local
 
 
@@ -141,7 +144,7 @@ def _reduce_task(reducer_index: int, seed: int, epoch: int,
                  stats_collector) -> pa.Table:
     """Collect this reducer's chunk from every global file, then
     concat + seeded permute (global-index RNG => topology-independent)."""
-    chunks: List[pa.Table] = []
+    chunks: List = []  # LazyChunk (local) or pa.Table (remote)
     for file_index in range(plan.num_files):
         src = plan.file_host(file_index)
         if src == transport.host_id:
@@ -161,14 +164,17 @@ def shuffle_epoch_distributed(epoch: int,
                               pool: ex.Executor,
                               seed: int,
                               trial_start: float,
-                              stats_collector=None) -> List[ex.TaskRef]:
+                              stats_collector=None,
+                              map_transform=None,
+                              file_cache=None) -> List[ex.TaskRef]:
     """One epoch on this host: map local files, reduce owned reducers,
     feed local trainers. Returns refs whose completion implies every
     cross-host send of this host's chunks has finished."""
     local_file_indices = plan.local_files(transport.host_id)
     map_refs: Dict[int, ex.TaskRef] = {
         fi: pool.submit(_map_task, filenames[fi], fi, plan.num_reducers,
-                        seed, epoch, plan, transport, stats_collector)
+                        seed, epoch, plan, transport, stats_collector,
+                        map_transform, file_cache)
         for fi in local_file_indices
     }
     reduce_refs: Dict[int, ex.TaskRef] = {
@@ -196,7 +202,9 @@ def shuffle_distributed(filenames: Sequence[str],
                         seed: int = 0,
                         num_workers: Optional[int] = None,
                         pool: Optional[ex.Executor] = None,
-                        start_epoch: int = 0) -> float:
+                        start_epoch: int = 0,
+                        map_transform=None,
+                        file_cache="auto") -> float:
     """Multi-epoch pipelined distributed shuffle driver for ONE host.
 
     Run with the same arguments on every host of the world (SPMD); hosts
@@ -211,6 +219,9 @@ def shuffle_distributed(filenames: Sequence[str],
             f"start_epoch {start_epoch} out of range [0, {num_epochs}]")
     plan = ShardPlan(len(filenames), num_reducers, transport.world,
                      trainers_per_host)
+    if file_cache == "auto":
+        file_cache = (sh.default_file_cache()
+                      if num_epochs - start_epoch > 1 else None)
     start = timeit.default_timer()
     owns_pool = pool is None
     if pool is None:
@@ -226,7 +237,8 @@ def shuffle_distributed(filenames: Sequence[str],
                     ref.result()
             in_progress[epoch_idx] = shuffle_epoch_distributed(
                 epoch_idx, filenames, batch_consumer, plan, transport, pool,
-                seed, start)
+                seed, start, map_transform=map_transform,
+                file_cache=file_cache)
         for epoch_idx in sorted(in_progress):
             refs = in_progress.pop(epoch_idx)
             ex.wait(refs, num_returns=len(refs))
@@ -249,7 +261,8 @@ def create_distributed_batch_queue_and_shuffle(
         seed: int = 0,
         num_workers: Optional[int] = None,
         queue_name: Optional[str] = None,
-        start_epoch: int = 0) -> Tuple[mq.MultiQueue, ex.TaskRef]:
+        start_epoch: int = 0,
+        map_transform=None) -> Tuple[mq.MultiQueue, ex.TaskRef]:
     """Host-local queue + background distributed shuffle driver.
 
     The returned ``(batch_queue, shuffle_result)`` plug straight into
@@ -272,7 +285,8 @@ def create_distributed_batch_queue_and_shuffle(
                 filenames, consumer, num_epochs, num_reducers, transport,
                 trainers_per_host=trainers_per_host,
                 max_concurrent_epochs=max_concurrent_epochs, seed=seed,
-                num_workers=num_workers, start_epoch=start_epoch)
+                num_workers=num_workers, start_epoch=start_epoch,
+                map_transform=map_transform)
         finally:
             driver_pool.shutdown(wait_for_tasks=False)
 

@@ -30,9 +30,11 @@ TPU-native design differences:
 
 from __future__ import annotations
 
+import threading
 import timeit
-from typing import Callable, Dict, List, Optional, Sequence, Tuple, Union
+from typing import (Callable, Dict, List, Optional, Sequence, Tuple, Union)
 
+import numpy as np
 import pyarrow as pa
 import pyarrow.parquet as pq
 
@@ -48,44 +50,303 @@ logger = setup_custom_logger(__name__)
 # reference: dataset.py:213-224).
 BatchConsumer = Callable[[int, int, Optional[Sequence[ex.TaskRef]]], None]
 
+# Optional table -> table hook applied by the map task right after the
+# Parquet read (e.g. cast int64 -> int32 before any shuffling, halving all
+# downstream memory traffic). Must be row-order preserving.
+MapTransform = Callable[[pa.Table], pa.Table]
+
+# Per-call thread count for the native fused scatter-gather. Modest so that
+# concurrently-running reduce tasks (the executor's parallelism) don't
+# oversubscribe the host; on a 1-core host this is 1.
+import os as _os
+_SCATTER_GATHER_THREADS = max(1, min(4, (_os.cpu_count() or 1)))
+
+
+def _table_numpy_columns(table: pa.Table) -> Optional[Dict[str, np.ndarray]]:
+    """{column -> 1-D ndarray} views of a table, or None if any column is
+    non-primitive / nullable (those fall back to the Arrow concat+take
+    reduce path)."""
+    cols: Dict[str, np.ndarray] = {}
+    for name in table.column_names:
+        col = table.column(name)
+        if col.null_count != 0:
+            return None
+        t = col.type
+        if not (pa.types.is_integer(t) or pa.types.is_floating(t)
+                or pa.types.is_boolean(t)):
+            return None
+        if col.num_chunks == 0:
+            cols[name] = np.empty(0, dtype=t.to_pandas_dtype())
+            continue
+        combined = (col.chunk(0) if col.num_chunks == 1
+                    else col.combine_chunks())
+        arr = combined.to_numpy(zero_copy_only=False)
+        if arr.dtype == object:
+            return None
+        cols[name] = arr
+    return cols
+
+
+class FileTableCache:
+    """Bounded, thread-safe cache of decoded (and map-transformed) tables.
+
+    The reference re-reads and re-decodes every Parquet file every epoch
+    (reference: shuffle.py:208) — Ray's stateless tasks can't do better, and
+    the OS page cache only skips disk IO, not decompression/decode. Our map
+    tasks are host-local, so steady-state epochs can skip the whole
+    read+decode+cast stage. Insertion stops at the byte budget (no
+    eviction: every cached file is hit once per epoch, so LRU churn would
+    only add copies).
+    """
+
+    def __init__(self, max_bytes: int):
+        self.max_bytes = max_bytes
+        self._bytes = 0
+        self._tables: Dict[str, pa.Table] = {}
+        self._lock = threading.Lock()
+
+    def get(self, key: str) -> Optional[pa.Table]:
+        with self._lock:
+            return self._tables.get(key)
+
+    def put(self, key: str, table: pa.Table) -> None:
+        with self._lock:
+            if key in self._tables:
+                return
+            nbytes = table.nbytes
+            if self._bytes + nbytes > self.max_bytes:
+                return
+            self._tables[key] = table
+            self._bytes += nbytes
+
+    @property
+    def bytes_cached(self) -> int:
+        with self._lock:
+            return self._bytes
+
+
+def default_file_cache() -> Optional[FileTableCache]:
+    """Cache budgeted at 1/3 of currently-available host RAM (None if that
+    cannot be determined)."""
+    try:
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemAvailable:"):
+                    kb = int(line.split()[1])
+                    return FileTableCache(max_bytes=kb * 1024 // 3)
+    except (OSError, ValueError, IndexError):
+        pass
+    return None
+
+
+class MapShard:
+    """Lazy map output: the source table plus per-reducer row-index arrays.
+
+    The reference's map task materializes ``num_reducers`` DataFrame
+    partitions (reference: shuffle.py:215-220). Within a host that gather
+    is pure waste — the reduce permutation gathers the same rows again — so
+    the map task only *plans* the partition and the reduce task performs a
+    single fused gather (see :func:`shuffle_reduce`). Materialized
+    partitions are still available via indexing/iteration for the
+    cross-host transport path.
+    """
+
+    __slots__ = ("table", "index_parts", "_np_cols", "_np_cols_known",
+                 "_np_cols_lock")
+
+    def __init__(self, table: pa.Table, index_parts: List[np.ndarray]):
+        self.table = table
+        self.index_parts = index_parts
+        self._np_cols: Optional[Dict[str, np.ndarray]] = None
+        self._np_cols_known = False
+        self._np_cols_lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.index_parts)
+
+    def __getitem__(self, reducer_index: int) -> "LazyChunk":
+        return LazyChunk(self, reducer_index)
+
+    def __iter__(self):
+        return (self[r] for r in range(len(self.index_parts)))
+
+    def numpy_columns(self) -> Optional[Dict[str, np.ndarray]]:
+        """Cached numpy views of the source table (None if ineligible).
+
+        Locked: all of this shard's reduce tasks race here at once, and an
+        unsynchronized miss would make each of them combine_chunks() its own
+        full copy of the source table.
+        """
+        if self._np_cols_known:
+            return self._np_cols
+        with self._np_cols_lock:
+            if not self._np_cols_known:
+                self._np_cols = _table_numpy_columns(self.table)
+                self._np_cols_known = True
+        return self._np_cols
+
+
+class LazyChunk:
+    """One reducer's slice of a map output, gathered only on demand."""
+
+    __slots__ = ("shard", "reducer_index")
+
+    def __init__(self, shard: MapShard, reducer_index: int):
+        self.shard = shard
+        self.reducer_index = reducer_index
+
+    @property
+    def num_rows(self) -> int:
+        return len(self.shard.index_parts[self.reducer_index])
+
+    @property
+    def indices(self) -> np.ndarray:
+        return self.shard.index_parts[self.reducer_index]
+
+    def materialize(self) -> pa.Table:
+        return self.shard.table.take(self.indices)
+
 
 def shuffle_map(filename: str,
                 num_reducers: int,
                 seed: int,
                 epoch: int,
                 file_index: int,
-                stats_collector=None) -> List[pa.Table]:
-    """Read one file and scatter its rows into per-reducer tables
-    (reference: shuffle.py:199-226)."""
+                stats_collector=None,
+                map_transform: Optional[MapTransform] = None,
+                file_cache: Optional[FileTableCache] = None) -> MapShard:
+    """Read one file and plan the scatter of its rows across reducers
+    (reference: shuffle.py:199-226 — but the per-reducer gather is deferred
+    to the reduce task, which fuses it with the shuffle permutation)."""
     if stats_collector is not None:
         stats_collector.map_start(epoch)
     start = timeit.default_timer()
-    table = pq.read_table(filename)
+    table = file_cache.get(filename) if file_cache is not None else None
+    if table is None:
+        table = pq.read_table(filename)
+        if map_transform is not None:
+            table = map_transform(table)
+        if file_cache is not None:
+            # Single-chunk columns => every later epoch's numpy views of
+            # this table are zero-copy.
+            table = table.combine_chunks()
+            file_cache.put(filename, table)
     end_read = timeit.default_timer()
     rng = ops.map_rng(seed, epoch, file_index)
     assignments = ops.assign_reducers(table.num_rows, num_reducers, rng)
     index_parts = ops.partition_indices(assignments, num_reducers)
-    parts = [table.take(idx) for idx in index_parts]
+    shard = MapShard(table, index_parts)
     if stats_collector is not None:
         stats_collector.map_done(epoch, timeit.default_timer() - start,
                                  end_read - start)
-    return parts
+    return shard
+
+
+def _fused_reduce(reduce_index: int, seed: int, epoch: int,
+                  sources: Sequence[Tuple[Dict[str, np.ndarray],
+                                          Optional[np.ndarray], int]],
+                  column_names: Sequence[str]) -> pa.Table:
+    """Single-pass scatter-gather: out[i] = concat(chunks)[perm[i]].
+
+    Each source is ``(columns, row_indices_or_None, num_rows)``; ``None``
+    indices mean the source rows are already this reducer's chunk in order.
+    Bit-identical to ``pa.concat_tables(chunks).take(perm)``.
+    """
+    counts = [n for _, _, n in sources]
+    total = sum(counts)
+    perm = ops.permutation(total, ops.reduce_rng(seed, epoch, reduce_index))
+    # inverse permutation: concat-order row j lands at output position inv[j].
+    # int32 indices: the scatter-gather's dominant memory traffic is the
+    # index arrays themselves (2 index reads per row per column), so
+    # halving index width outruns the one-time casts. idx values address the
+    # SOURCE table (not this reducer's output), so the width must cover the
+    # largest source row count as well as `total`.
+    max_source_rows = max(
+        (next(iter(cols.values())).size for cols, _, _ in sources if cols),
+        default=0)
+    index_dtype = (np.int32 if max(total, max_source_rows) < 2**31
+                   else np.int64)
+    inv = np.empty(total, dtype=index_dtype)
+    inv[perm] = np.arange(total, dtype=index_dtype)
+    sources = [(cols, None if idx is None
+                else idx.astype(index_dtype, copy=False), n)
+               for cols, idx, n in sources]
+    from ray_shuffling_data_loader_tpu import native
+    use_native = native.available() and index_dtype == np.int32
+    out_cols = {}
+    for name in column_names:
+        dtype = sources[0][0][name].dtype
+        out = np.empty(total, dtype=dtype)
+        offset = 0
+        for cols, idx, n in sources:
+            dest = inv[offset:offset + n]
+            src = cols[name]
+            if (use_native and src.flags.c_contiguous
+                    and dtype.itemsize in (1, 2, 4, 8)):
+                native.scatter_gather(src, idx, dest, out,
+                                      nthreads=_SCATTER_GATHER_THREADS)
+            elif idx is None:
+                out[dest] = src
+            else:
+                out[dest] = src[idx]
+            offset += n
+        out_cols[name] = out
+    return pa.table(out_cols)
 
 
 def shuffle_reduce(reduce_index: int,
                    seed: int,
                    epoch: int,
-                   chunks: Sequence[pa.Table],
+                   chunks: Sequence[Union[pa.Table, LazyChunk]],
                    stats_collector=None) -> pa.Table:
     """Concatenate one chunk per file and permute the rows
-    (reference: shuffle.py:229-247)."""
+    (reference: shuffle.py:229-247).
+
+    Chunks may be materialized ``pa.Table``s (the cross-host path) or
+    :class:`LazyChunk`s (host-local map outputs). When every chunk's
+    columns are primitive and null-free the concat+permute+gather collapses
+    into ONE numpy scatter-gather pass per column — the output is
+    bit-identical to the materialize-concat-take path, at roughly half the
+    memory traffic.
+    """
     if stats_collector is not None:
         stats_collector.reduce_start(epoch)
     start = timeit.default_timer()
-    table = pa.concat_tables(chunks)
-    perm = ops.permutation(table.num_rows,
-                           ops.reduce_rng(seed, epoch, reduce_index))
-    shuffled = table.take(perm)
+    shuffled = None
+    sources = []
+    schema = None
+    for chunk in chunks:
+        if isinstance(chunk, LazyChunk):
+            cols = chunk.shard.numpy_columns()
+            if cols is None:
+                break
+            chunk_schema = chunk.shard.table.schema
+            sources.append((cols, chunk.indices, chunk.num_rows))
+        else:
+            cols = _table_numpy_columns(chunk)
+            if cols is None:
+                break
+            chunk_schema = chunk.schema
+            sources.append((cols, None, chunk.num_rows))
+        if schema is None:
+            schema = chunk_schema
+        elif schema != chunk_schema:
+            break
+    else:
+        if schema is not None:
+            shuffled = _fused_reduce(reduce_index, seed, epoch, sources,
+                                     schema.names)
+    if shuffled is None and chunks:
+        # Fallback: nested / nullable / mixed-schema columns.
+        tables = [
+            c.materialize() if isinstance(c, LazyChunk) else c for c in chunks
+        ]
+        table = pa.concat_tables(tables)
+        perm = ops.permutation(table.num_rows,
+                               ops.reduce_rng(seed, epoch, reduce_index))
+        shuffled = table.take(perm)
+    elif shuffled is None:
+        shuffled = pa.table({})
     if stats_collector is not None:
         stats_collector.reduce_done(epoch, timeit.default_timer() - start)
     return shuffled
@@ -96,8 +357,8 @@ def _reduce_task(reduce_index: int, seed: int, epoch: int,
     """Executor wrapper: resolve this reducer's chunk from every map output.
 
     Equivalent of Ray resolving ``shuffle_reduce.remote(*refs)`` argument
-    refs (reference: shuffle.py:182-187) — but we fetch only column slice
-    ``reduce_index`` of each map result, zero-copy.
+    refs (reference: shuffle.py:182-187) — but the chunks stay lazy
+    (index arrays into the map tables) until the fused reduce gathers them.
     """
     chunks = [ref.result()[reduce_index] for ref in map_refs]
     return shuffle_reduce(reduce_index, seed, epoch, chunks, stats_collector)
@@ -128,14 +389,17 @@ def shuffle_epoch(epoch: int,
                   pool: ex.Executor,
                   seed: int,
                   trial_start: float,
-                  stats_collector=None) -> List[ex.TaskRef]:
+                  stats_collector=None,
+                  map_transform: Optional[MapTransform] = None,
+                  file_cache: Optional[FileTableCache] = None
+                  ) -> List[ex.TaskRef]:
     """Launch one epoch's map/reduce and route outputs to trainers
     (reference: shuffle.py:163-196). Returns the reducer TaskRefs."""
     if stats_collector is not None:
         stats_collector.epoch_start(epoch)
     map_refs = [
         pool.submit(shuffle_map, filename, num_reducers, seed, epoch,
-                    file_index, stats_collector)
+                    file_index, stats_collector, map_transform, file_cache)
         for file_index, filename in enumerate(filenames)
     ]
     reduce_refs = [
@@ -162,7 +426,9 @@ def shuffle(filenames: Sequence[str],
             num_workers: Optional[int] = None,
             collect_stats: bool = True,
             pool: Optional[ex.Executor] = None,
-            start_epoch: int = 0
+            start_epoch: int = 0,
+            map_transform: Optional[MapTransform] = None,
+            file_cache: Union[FileTableCache, None, str] = "auto"
             ) -> Union[stats_mod.TrialStats, float]:
     """Multi-epoch pipelined shuffle driver (reference: shuffle.py:79-160).
 
@@ -193,6 +459,10 @@ def shuffle(filenames: Sequence[str],
         stats_collector.trial_start()
     start = timeit.default_timer()
 
+    if file_cache == "auto":
+        # Caching only pays when a file is mapped more than once.
+        file_cache = (default_file_cache()
+                      if num_epochs - start_epoch > 1 else None)
     owns_pool = pool is None
     if pool is None:
         pool = ex.Executor(num_workers=num_workers)
@@ -216,7 +486,8 @@ def shuffle(filenames: Sequence[str],
                             throttle_duration)
             in_progress[epoch_idx] = shuffle_epoch(
                 epoch_idx, filenames, batch_consumer, num_reducers,
-                num_trainers, pool, seed, start, stats_collector)
+                num_trainers, pool, seed, start, stats_collector,
+                map_transform, file_cache)
         # Final drain: wait for all remaining reducer tasks
         # (reference: shuffle.py:148-151).
         for epoch_idx in sorted(in_progress):
@@ -286,7 +557,9 @@ def run_shuffle_in_background(
         seed: int = 0,
         num_workers: Optional[int] = None,
         collect_stats: bool = False,
-        start_epoch: int = 0) -> ex.TaskRef:
+        start_epoch: int = 0,
+        map_transform: Optional[MapTransform] = None,
+        file_cache: Union[FileTableCache, None, str] = "auto") -> ex.TaskRef:
     """Launch the whole multi-epoch shuffle as one background task.
 
     Stands in for the reference driver's ``ray.remote(shuffle).remote(...)``
@@ -303,7 +576,9 @@ def run_shuffle_in_background(
                            num_reducers, num_trainers, max_concurrent_epochs,
                            seed=seed, num_workers=num_workers,
                            collect_stats=collect_stats,
-                           start_epoch=start_epoch)
+                           start_epoch=start_epoch,
+                           map_transform=map_transform,
+                           file_cache=file_cache)
         finally:
             driver_pool.shutdown(wait_for_tasks=False)
 

@@ -1,0 +1,264 @@
+"""Tests for the fused shuffle paths: lazy map shards, the single-pass
+scatter-gather reduce, the decoded-file cache, map-time casting, and
+stacked-feature batches."""
+
+import glob
+import importlib
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ray_shuffling_data_loader_tpu import jax_dataset as jd
+from ray_shuffling_data_loader_tpu import multiqueue as mq
+from ray_shuffling_data_loader_tpu import native
+
+sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    mq._REGISTRY.clear()
+    yield
+    mq._REGISTRY.clear()
+
+
+def write_numeric_files(tmp_path, num_files=3, rows_per_file=200):
+    filenames = []
+    for i in range(num_files):
+        start = i * rows_per_file
+        rng = np.random.default_rng(i)
+        table = pa.table({
+            "key": pa.array(range(start, start + rows_per_file),
+                            type=pa.int64()),
+            "a": pa.array(rng.integers(0, 1000, rows_per_file),
+                          type=pa.int64()),
+            "b": pa.array(rng.random(rows_per_file), type=pa.float64()),
+        })
+        path = str(tmp_path / f"f_{i}.parquet")
+        pq.write_table(table, path)
+        filenames.append(path)
+    return filenames
+
+
+def write_string_file(tmp_path):
+    table = pa.table({
+        "key": pa.array(range(100), type=pa.int64()),
+        "s": pa.array([f"row-{i}" for i in range(100)]),
+    })
+    path = str(tmp_path / "strings.parquet")
+    pq.write_table(table, path)
+    return path
+
+
+def test_fused_reduce_matches_materialized(tmp_path):
+    """The numpy scatter-gather output must be bit-identical to the Arrow
+    concat+take path on the same chunks."""
+    filenames = write_numeric_files(tmp_path)
+    shards = [
+        sh.shuffle_map(f, 4, seed=9, epoch=1, file_index=i)
+        for i, f in enumerate(filenames)
+    ]
+    for r in range(4):
+        fused = sh.shuffle_reduce(r, seed=9, epoch=1,
+                                  chunks=[s[r] for s in shards])
+        materialized = sh.shuffle_reduce(
+            r, seed=9, epoch=1, chunks=[s[r].materialize() for s in shards])
+        assert fused.equals(materialized)
+        # Cross-check against the unfused reference formulation.
+        concat = pa.concat_tables([s[r].materialize() for s in shards])
+        from ray_shuffling_data_loader_tpu.ops import partition as ops
+        perm = ops.permutation(concat.num_rows, ops.reduce_rng(9, 1, r))
+        assert fused.equals(concat.take(perm))
+
+
+def test_fused_reduce_mixed_lazy_and_tables(tmp_path):
+    """Distributed reduces mix LazyChunks (local) and Tables (remote)."""
+    filenames = write_numeric_files(tmp_path, num_files=2)
+    shards = [
+        sh.shuffle_map(f, 2, seed=0, epoch=0, file_index=i)
+        for i, f in enumerate(filenames)
+    ]
+    mixed = sh.shuffle_reduce(
+        0, seed=0, epoch=0, chunks=[shards[0][0], shards[1][0].materialize()])
+    pure = sh.shuffle_reduce(0, seed=0, epoch=0,
+                             chunks=[s[0] for s in shards])
+    assert mixed.equals(pure)
+
+
+def test_nonprimitive_columns_fall_back(tmp_path):
+    """String columns must take the Arrow concat+take path and still produce
+    a correct permutation."""
+    path = write_string_file(tmp_path)
+    shard = sh.shuffle_map(path, 2, seed=0, epoch=0, file_index=0)
+    out0 = sh.shuffle_reduce(0, seed=0, epoch=0, chunks=[shard[0]])
+    out1 = sh.shuffle_reduce(1, seed=0, epoch=0, chunks=[shard[1]])
+    keys = out0.column("key").to_pylist() + out1.column("key").to_pylist()
+    assert sorted(keys) == list(range(100))
+    for out in (out0, out1):
+        for key, s in zip(out.column("key").to_pylist(),
+                          out.column("s").to_pylist()):
+            assert s == f"row-{key}"  # rows stay intact through the shuffle
+
+
+def test_map_shard_lazy_api(tmp_path):
+    filenames = write_numeric_files(tmp_path, num_files=1, rows_per_file=50)
+    shard = sh.shuffle_map(filenames[0], 3, seed=1, epoch=0, file_index=0)
+    assert len(shard) == 3
+    chunks = list(shard)
+    assert sum(c.num_rows for c in chunks) == 50
+    for c in chunks:
+        mat = c.materialize()
+        assert mat.num_rows == c.num_rows
+        np.testing.assert_array_equal(
+            mat.column("key").to_numpy(),
+            shard.table.column("key").to_numpy()[c.indices])
+
+
+def test_file_table_cache_hit_and_budget(tmp_path):
+    filenames = write_numeric_files(tmp_path, num_files=2)
+    cache = sh.FileTableCache(max_bytes=1 << 30)
+    s1 = sh.shuffle_map(filenames[0], 2, 0, 0, 0, file_cache=cache)
+    assert cache.bytes_cached > 0
+    s2 = sh.shuffle_map(filenames[0], 2, 0, 1, 0, file_cache=cache)
+    # Same underlying table object on the cache hit.
+    assert s1.table is s2.table
+    # A zero-budget cache never stores but still works.
+    tiny = sh.FileTableCache(max_bytes=0)
+    s3 = sh.shuffle_map(filenames[1], 2, 0, 0, 1, file_cache=tiny)
+    assert tiny.bytes_cached == 0
+    assert s3.table.num_rows == 200
+
+
+def test_cached_epochs_replay_identically(tmp_path):
+    """The shuffle with a file cache produces the same epochs as without."""
+    filenames = write_numeric_files(tmp_path)
+
+    def run(file_cache):
+        outs = {}
+        for epoch in range(2):
+            shards = [
+                sh.shuffle_map(f, 2, seed=5, epoch=epoch, file_index=i,
+                               file_cache=file_cache)
+                for i, f in enumerate(filenames)
+            ]
+            for r in range(2):
+                outs[(epoch, r)] = sh.shuffle_reduce(
+                    r, seed=5, epoch=epoch, chunks=[s[r] for s in shards])
+        return outs
+
+    with_cache = run(sh.FileTableCache(max_bytes=1 << 30))
+    without = run(None)
+    for key in without:
+        assert with_cache[key].equals(without[key])
+
+
+def test_cast_transform_casts_spec_columns(tmp_path):
+    filenames = write_numeric_files(tmp_path, num_files=1)
+    transform = jd.make_cast_transform(
+        ["a"], [np.dtype(np.int32)], "b", np.dtype(np.float32))
+    table = pq.read_table(filenames[0])
+    out = transform(table)
+    assert out.schema.field("a").type == pa.int32()
+    assert out.schema.field("b").type == pa.float32()
+    assert out.schema.field("key").type == pa.int64()  # untouched
+    np.testing.assert_array_equal(
+        out.column("a").to_numpy(),
+        table.column("a").to_numpy().astype(np.int32))
+
+
+def test_cast_transform_noop_when_types_match(tmp_path):
+    filenames = write_numeric_files(tmp_path, num_files=1)
+    transform = jd.make_cast_transform(
+        ["a"], [np.dtype(np.int64)], "b", np.dtype(np.float64))
+    table = pq.read_table(filenames[0])
+    assert transform(table) is table
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_native_scatter_gather_matches_numpy():
+    rng = np.random.default_rng(3)
+    for dtype in (np.int8, np.int16, np.int32, np.int64, np.float32,
+                  np.float64):
+        src = rng.integers(0, 100, 5000).astype(dtype)
+        idx = rng.permutation(5000)[:3000].astype(np.int32)
+        dest = rng.permutation(3000).astype(np.int32)
+        out = np.empty(3000, dtype)
+        native.scatter_gather(src, idx, dest, out)
+        ref = np.empty(3000, dtype)
+        ref[dest] = src[idx]
+        np.testing.assert_array_equal(out, ref)
+        # identity-index form
+        out2 = np.empty(3000, dtype)
+        native.scatter_gather(src[:3000], None, dest, out2)
+        ref2 = np.empty(3000, dtype)
+        ref2[dest] = src[:3000]
+        np.testing.assert_array_equal(out2, ref2)
+
+
+@pytest.mark.skipif(not native.available(), reason="native lib unavailable")
+def test_native_scatter_gather_threaded():
+    rng = np.random.default_rng(4)
+    n = 1 << 17  # above the threading threshold
+    src = rng.integers(0, 1 << 30, n).astype(np.int64)
+    idx = rng.permutation(n).astype(np.int32)
+    dest = rng.permutation(n).astype(np.int32)
+    out = np.empty(n, np.int64)
+    native.scatter_gather(src, idx, dest, out, nthreads=4)
+    ref = np.empty(n, np.int64)
+    ref[dest] = src[idx]
+    np.testing.assert_array_equal(out, ref)
+
+
+def test_stack_features_single_array(tmp_path):
+    filenames = write_numeric_files(tmp_path, num_files=2)
+    ds = jd.JaxShufflingDataset(
+        filenames, num_epochs=1, num_trainers=1, batch_size=64, rank=0,
+        feature_columns=["a", "key"],
+        feature_types=[np.int32, np.int32],
+        label_column="b", num_reducers=2, seed=0, device_put=False,
+        queue_name="stack-test", stack_features=True)
+    ds.set_epoch(0)
+    batches = list(ds)
+    assert len(batches) > 0
+    for features, label in batches:
+        assert isinstance(features, np.ndarray)
+        assert features.shape == (64, 2)
+        assert features.dtype == np.int32
+        assert label.shape == (64, 1)
+        assert label.dtype == np.float32
+
+
+def test_stack_features_rejects_mixed_dtypes(tmp_path):
+    filenames = write_numeric_files(tmp_path, num_files=1)
+    with pytest.raises(ValueError, match="identical feature dtypes"):
+        jd.JaxShufflingDataset(
+            filenames, num_epochs=1, num_trainers=1, batch_size=8, rank=0,
+            feature_columns=["a", "key"],
+            feature_types=[np.int32, np.float32],
+            label_column="b", queue_name="stack-mixed",
+            stack_features=True)
+
+
+def test_cast_at_map_preserves_values_end_to_end(tmp_path):
+    """With cast_at_map the batches must carry the same values as without."""
+    filenames = write_numeric_files(tmp_path, num_files=2)
+
+    def collect(cast_at_map, queue_name):
+        ds = jd.JaxShufflingDataset(
+            filenames, num_epochs=1, num_trainers=1, batch_size=50, rank=0,
+            feature_columns=["a"], feature_types=[np.int32],
+            label_column="b", num_reducers=2, seed=3, device_put=False,
+            queue_name=queue_name, cast_at_map=cast_at_map)
+        ds.set_epoch(0)
+        feats, labels = [], []
+        for f, y in ds:
+            feats.append(f[0] if isinstance(f, list) else f)
+            labels.append(y)
+        return np.concatenate(feats), np.concatenate(labels)
+
+    f_cast, y_cast = collect(True, "cast-on")
+    f_raw, y_raw = collect(False, "cast-off")
+    np.testing.assert_array_equal(f_cast, f_raw)
+    np.testing.assert_array_equal(y_cast, y_raw)
