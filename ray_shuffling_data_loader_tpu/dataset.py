@@ -77,7 +77,8 @@ def create_batch_queue_and_shuffle(
         num_workers: Optional[int] = None,
         queue_name: str = MULTIQUEUE_NAME,
         start_epoch: int = 0,
-        map_transform=None):
+        map_transform=None,
+        reduce_transform=None):
     """Driver-mode helper: create the queue and start the shuffle before any
     trainer exists, so every rank can be a pure consumer
     (reference: dataset.py:17-51)."""
@@ -103,7 +104,8 @@ def create_batch_queue_and_shuffle(
         num_workers=num_workers,
         collect_stats=False,
         start_epoch=start_epoch,
-        map_transform=map_transform)
+        map_transform=map_transform,
+        reduce_transform=reduce_transform)
     return batch_queue, shuffle_result
 
 
@@ -139,7 +141,8 @@ class ShufflingDataset:
                  num_workers: Optional[int] = None,
                  queue_name: str = MULTIQUEUE_NAME,
                  start_epoch: int = 0,
-                 map_transform=None):
+                 map_transform=None,
+                 reduce_transform=None):
         if num_reducers is None:
             num_reducers = default_num_reducers(num_trainers)
         self._batch_size = batch_size
@@ -154,7 +157,8 @@ class ShufflingDataset:
                         max_batch_queue_size, seed=seed,
                         num_workers=num_workers, queue_name=queue_name,
                         start_epoch=start_epoch,
-                        map_transform=map_transform))
+                        map_transform=map_transform,
+                        reduce_transform=reduce_transform))
                 self._owns_queue = True
             else:
                 self._batch_queue = mq.MultiQueue(

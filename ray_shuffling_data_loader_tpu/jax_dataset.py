@@ -95,7 +95,17 @@ def _column_to_numpy(column: pa.ChunkedArray, dtype: np.dtype) -> np.ndarray:
     """
     combined = (column.chunk(0) if column.num_chunks == 1
                 else column.combine_chunks())
-    if pa.types.is_list(combined.type) or pa.types.is_large_list(combined.type) \
+    if (pa.types.is_fixed_size_list(combined.type)
+            and pa.types.is_primitive(combined.type.value_type)
+            and combined.null_count == 0):
+        # Fast path for image pixels / token sequences: the child values
+        # buffer IS the (rows * list_size) array — flatten() respects the
+        # slice offset, so the reshape is zero-copy.
+        width = combined.type.list_size
+        flat = combined.flatten().to_numpy(zero_copy_only=False)
+        arr = flat.reshape(-1, width)
+    elif pa.types.is_list(combined.type) \
+            or pa.types.is_large_list(combined.type) \
             or pa.types.is_fixed_size_list(combined.type):
         arr = np.stack(combined.to_numpy(zero_copy_only=False))
     else:
@@ -212,6 +222,10 @@ class JaxShufflingDataset:
             :func:`make_cast_transform`. Only effective when this dataset
             launches the shuffle (rank 0 without an external
             ``batch_queue``).
+        reduce_transform: optional ``pa.Table -> pa.Table`` hook run by each
+            reduce task on its shuffled output — e.g. image decode inside
+            the reducers (``workloads.imagenet.decode_transform``). Only
+            effective when this dataset launches the shuffle.
     """
 
     def __init__(self,
@@ -241,7 +255,8 @@ class JaxShufflingDataset:
                  device_put: bool = True,
                  start_epoch: int = 0,
                  stack_features: bool = False,
-                 cast_at_map: bool = True):
+                 cast_at_map: bool = True,
+                 reduce_transform=None):
         (self._feature_columns, self._feature_shapes, self._feature_types,
          self._label_column, self._label_shape, self._label_type) = (
              _normalize_jax_data_spec(feature_columns, feature_shapes,
@@ -270,7 +285,8 @@ class JaxShufflingDataset:
             batch_queue=batch_queue, shuffle_result=shuffle_result,
             max_batch_queue_size=max_batch_queue_size, seed=seed,
             num_workers=num_workers, queue_name=queue_name,
-            start_epoch=start_epoch, map_transform=map_transform)
+            start_epoch=start_epoch, map_transform=map_transform,
+            reduce_transform=reduce_transform)
         self._mesh = mesh
         self._data_axis = data_axis
         self._prefetch_size = max(1, prefetch_size)

@@ -141,7 +141,7 @@ def _map_task(filename: str, global_file_index: int, num_reducers: int,
 def _reduce_task(reducer_index: int, seed: int, epoch: int,
                  plan: ShardPlan, transport: TcpTransport,
                  local_map_refs: Dict[int, ex.TaskRef],
-                 stats_collector) -> pa.Table:
+                 stats_collector, reduce_transform=None) -> pa.Table:
     """Collect this reducer's chunk from every global file, then
     concat + seeded permute (global-index RNG => topology-independent)."""
     chunks: List = []  # LazyChunk (local) or pa.Table (remote)
@@ -153,7 +153,7 @@ def _reduce_task(reducer_index: int, seed: int, epoch: int,
             payload = transport.recv(src, (epoch, reducer_index, file_index))
             chunks.append(deserialize_table(payload))
     return sh.shuffle_reduce(reducer_index, seed, epoch, chunks,
-                             stats_collector)
+                             stats_collector, reduce_transform)
 
 
 def shuffle_epoch_distributed(epoch: int,
@@ -166,7 +166,8 @@ def shuffle_epoch_distributed(epoch: int,
                               trial_start: float,
                               stats_collector=None,
                               map_transform=None,
-                              file_cache=None) -> List[ex.TaskRef]:
+                              file_cache=None,
+                              reduce_transform=None) -> List[ex.TaskRef]:
     """One epoch on this host: map local files, reduce owned reducers,
     feed local trainers. Returns refs whose completion implies every
     cross-host send of this host's chunks has finished."""
@@ -179,7 +180,7 @@ def shuffle_epoch_distributed(epoch: int,
     }
     reduce_refs: Dict[int, ex.TaskRef] = {
         r: pool.submit(_reduce_task, r, seed, epoch, plan, transport,
-                       map_refs, stats_collector)
+                       map_refs, stats_collector, reduce_transform)
         for r in plan.local_reducers(transport.host_id)
     }
     for local_rank, trainer in enumerate(plan.local_trainers(transport.host_id)):
@@ -204,7 +205,8 @@ def shuffle_distributed(filenames: Sequence[str],
                         pool: Optional[ex.Executor] = None,
                         start_epoch: int = 0,
                         map_transform=None,
-                        file_cache="auto") -> float:
+                        file_cache="auto",
+                        reduce_transform=None) -> float:
     """Multi-epoch pipelined distributed shuffle driver for ONE host.
 
     Run with the same arguments on every host of the world (SPMD); hosts
@@ -238,7 +240,7 @@ def shuffle_distributed(filenames: Sequence[str],
             in_progress[epoch_idx] = shuffle_epoch_distributed(
                 epoch_idx, filenames, batch_consumer, plan, transport, pool,
                 seed, start, map_transform=map_transform,
-                file_cache=file_cache)
+                file_cache=file_cache, reduce_transform=reduce_transform)
         for epoch_idx in sorted(in_progress):
             refs = in_progress.pop(epoch_idx)
             ex.wait(refs, num_returns=len(refs))
@@ -262,7 +264,8 @@ def create_distributed_batch_queue_and_shuffle(
         num_workers: Optional[int] = None,
         queue_name: Optional[str] = None,
         start_epoch: int = 0,
-        map_transform=None) -> Tuple[mq.MultiQueue, ex.TaskRef]:
+        map_transform=None,
+        reduce_transform=None) -> Tuple[mq.MultiQueue, ex.TaskRef]:
     """Host-local queue + background distributed shuffle driver.
 
     The returned ``(batch_queue, shuffle_result)`` plug straight into
@@ -286,7 +289,8 @@ def create_distributed_batch_queue_and_shuffle(
                 trainers_per_host=trainers_per_host,
                 max_concurrent_epochs=max_concurrent_epochs, seed=seed,
                 num_workers=num_workers, start_epoch=start_epoch,
-                map_transform=map_transform)
+                map_transform=map_transform,
+                reduce_transform=reduce_transform)
         finally:
             driver_pool.shutdown(wait_for_tasks=False)
 

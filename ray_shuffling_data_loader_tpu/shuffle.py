@@ -55,6 +55,13 @@ BatchConsumer = Callable[[int, int, Optional[Sequence[ex.TaskRef]]], None]
 # downstream memory traffic). Must be row-order preserving.
 MapTransform = Callable[[pa.Table], pa.Table]
 
+# Optional table -> table hook applied by the reduce task to its shuffled
+# output (e.g. decode encoded image bytes into fixed-shape pixel columns —
+# BASELINE config 3 runs image decode inside shuffle reducers so the decode
+# cost is spread over the reducer pool and overlaps training). Must be
+# row-order preserving; runs once per reducer per epoch.
+ReduceTransform = Callable[[pa.Table], pa.Table]
+
 # Per-call thread count for the native fused scatter-gather. Modest so that
 # concurrently-running reduce tasks (the executor's parallelism) don't
 # oversubscribe the host; on a 1-core host this is 1.
@@ -298,7 +305,9 @@ def shuffle_reduce(reduce_index: int,
                    seed: int,
                    epoch: int,
                    chunks: Sequence[Union[pa.Table, LazyChunk]],
-                   stats_collector=None) -> pa.Table:
+                   stats_collector=None,
+                   reduce_transform: Optional[ReduceTransform] = None
+                   ) -> pa.Table:
     """Concatenate one chunk per file and permute the rows
     (reference: shuffle.py:229-247).
 
@@ -347,13 +356,20 @@ def shuffle_reduce(reduce_index: int,
         shuffled = table.take(perm)
     elif shuffled is None:
         shuffled = pa.table({})
+    # Applied even to 0-row outputs: a schema-changing transform (e.g.
+    # image decode) must keep every reducer's schema identical or the
+    # iterator's carry-buffer concat breaks on the mixed schemas.
+    if reduce_transform is not None and shuffled.num_columns:
+        shuffled = reduce_transform(shuffled)
     if stats_collector is not None:
         stats_collector.reduce_done(epoch, timeit.default_timer() - start)
     return shuffled
 
 
 def _reduce_task(reduce_index: int, seed: int, epoch: int,
-                 map_refs: Sequence[ex.TaskRef], stats_collector) -> pa.Table:
+                 map_refs: Sequence[ex.TaskRef], stats_collector,
+                 reduce_transform: Optional[ReduceTransform] = None
+                 ) -> pa.Table:
     """Executor wrapper: resolve this reducer's chunk from every map output.
 
     Equivalent of Ray resolving ``shuffle_reduce.remote(*refs)`` argument
@@ -361,7 +377,8 @@ def _reduce_task(reduce_index: int, seed: int, epoch: int,
     (index arrays into the map tables) until the fused reduce gathers them.
     """
     chunks = [ref.result()[reduce_index] for ref in map_refs]
-    return shuffle_reduce(reduce_index, seed, epoch, chunks, stats_collector)
+    return shuffle_reduce(reduce_index, seed, epoch, chunks, stats_collector,
+                          reduce_transform)
 
 
 def consume(trainer_idx: int,
@@ -391,7 +408,8 @@ def shuffle_epoch(epoch: int,
                   trial_start: float,
                   stats_collector=None,
                   map_transform: Optional[MapTransform] = None,
-                  file_cache: Optional[FileTableCache] = None
+                  file_cache: Optional[FileTableCache] = None,
+                  reduce_transform: Optional[ReduceTransform] = None
                   ) -> List[ex.TaskRef]:
     """Launch one epoch's map/reduce and route outputs to trainers
     (reference: shuffle.py:163-196). Returns the reducer TaskRefs."""
@@ -404,7 +422,7 @@ def shuffle_epoch(epoch: int,
     ]
     reduce_refs = [
         pool.submit(_reduce_task, reduce_index, seed, epoch, map_refs,
-                    stats_collector)
+                    stats_collector, reduce_transform)
         for reduce_index in range(num_reducers)
     ]
     for trainer_idx, batches in enumerate(
@@ -428,7 +446,8 @@ def shuffle(filenames: Sequence[str],
             pool: Optional[ex.Executor] = None,
             start_epoch: int = 0,
             map_transform: Optional[MapTransform] = None,
-            file_cache: Union[FileTableCache, None, str] = "auto"
+            file_cache: Union[FileTableCache, None, str] = "auto",
+            reduce_transform: Optional[ReduceTransform] = None
             ) -> Union[stats_mod.TrialStats, float]:
     """Multi-epoch pipelined shuffle driver (reference: shuffle.py:79-160).
 
@@ -487,7 +506,7 @@ def shuffle(filenames: Sequence[str],
             in_progress[epoch_idx] = shuffle_epoch(
                 epoch_idx, filenames, batch_consumer, num_reducers,
                 num_trainers, pool, seed, start, stats_collector,
-                map_transform, file_cache)
+                map_transform, file_cache, reduce_transform)
         # Final drain: wait for all remaining reducer tasks
         # (reference: shuffle.py:148-151).
         for epoch_idx in sorted(in_progress):
@@ -559,7 +578,8 @@ def run_shuffle_in_background(
         collect_stats: bool = False,
         start_epoch: int = 0,
         map_transform: Optional[MapTransform] = None,
-        file_cache: Union[FileTableCache, None, str] = "auto") -> ex.TaskRef:
+        file_cache: Union[FileTableCache, None, str] = "auto",
+        reduce_transform: Optional[ReduceTransform] = None) -> ex.TaskRef:
     """Launch the whole multi-epoch shuffle as one background task.
 
     Stands in for the reference driver's ``ray.remote(shuffle).remote(...)``
@@ -578,7 +598,8 @@ def run_shuffle_in_background(
                            collect_stats=collect_stats,
                            start_epoch=start_epoch,
                            map_transform=map_transform,
-                           file_cache=file_cache)
+                           file_cache=file_cache,
+                           reduce_transform=reduce_transform)
         finally:
             driver_pool.shutdown(wait_for_tasks=False)
 

@@ -1,0 +1,44 @@
+"""End-to-end workload recipes for the BASELINE.json target configs.
+
+Each module pairs a seeded synthetic data generator (the de-facto universal
+fixture, following the reference's data_generation.py) with the column spec
+and transform hooks that wire the workload into ``JaxShufflingDataset``:
+
+- ``imagenet``: ResNet-50 on ImageNet-style Parquet shards — encoded image
+  bytes shuffled as-is, decoded to fixed-shape pixel columns INSIDE the
+  shuffle reducers (BASELINE config 3).
+- ``bert_mlm``: BERT MLM on pre-tokenized sequence Parquet — fixed-length
+  token list columns batched through the shuffle, with on-device dynamic
+  masking (BASELINE config 4).
+
+The tabular DLRM workload (configs 1/2/5) lives in ``data_generation`` +
+``models/dlrm`` since it is the reference's own data spec.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple
+
+
+def generate_shards(write_file: Callable[[int, int, int], Tuple[str, int]],
+                    total_rows: int,
+                    num_files: int,
+                    num_workers: Optional[int] = None,
+                    thread_name_prefix: str = "rsdl-gen"
+                    ) -> Tuple[List[str], int]:
+    """Shared parallel shard writer: fan ``write_file(file_index,
+    global_row_index, num_rows) -> (path, nbytes)`` out over the host pool
+    using data_generation's file plan (same stride arithmetic as the
+    reference, data_generation.py:19-23)."""
+    from ray_shuffling_data_loader_tpu import executor as ex
+    from ray_shuffling_data_loader_tpu.data_generation import _file_plan
+
+    with ex.Executor(num_workers=num_workers,
+                     thread_name_prefix=thread_name_prefix) as pool:
+        refs = [
+            pool.submit(write_file, file_index, start, n)
+            for file_index, start, n in _file_plan(total_rows, num_files)
+        ]
+        results = ex.get(refs)
+    filenames, sizes = zip(*results)
+    return list(filenames), sum(sizes)

@@ -152,14 +152,15 @@ def test_throttle_limits_concurrency(tmp_path):
     lock = threading.Lock()
     orig_reduce = sh.shuffle_reduce
 
-    def tracking_reduce(reduce_index, seed, epoch, chunks, stats_collector=None):
+    def tracking_reduce(reduce_index, seed, epoch, chunks,
+                        stats_collector=None, reduce_transform=None):
         with lock:
             active["reduces"] += 1
             active["max_overlap"] = max(active["max_overlap"],
                                         active["reduces"])
         try:
             return orig_reduce(reduce_index, seed, epoch, chunks,
-                               stats_collector)
+                               stats_collector, reduce_transform)
         finally:
             with lock:
                 active["reduces"] -= 1

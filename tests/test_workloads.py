@@ -1,0 +1,233 @@
+"""E2E tests for the BASELINE config-3/4 workloads (workloads/imagenet.py,
+workloads/bert_mlm.py): image decode inside shuffle reducers, and
+sequence batching with on-device MLM masking."""
+
+import io
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pyarrow.parquet as pq
+import pytest
+
+from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
+from ray_shuffling_data_loader_tpu.models import bert, resnet
+from ray_shuffling_data_loader_tpu.models.bert import IGNORE_ID
+from ray_shuffling_data_loader_tpu.workloads import bert_mlm, imagenet
+
+HEIGHT = WIDTH = 8
+
+
+def test_generate_imagenet_parquet_roundtrip(tmp_parquet_dir):
+    filenames, _ = imagenet.generate_imagenet_parquet(
+        20, 2, tmp_parquet_dir, height=HEIGHT, width=WIDTH, num_classes=4,
+        seed=7)
+    assert len(filenames) == 2
+    table = pq.read_table(filenames[0])
+    assert table.column_names == [
+        imagenet.IMAGE_COLUMN, imagenet.LABEL_COLUMN, imagenet.KEY_COLUMN
+    ]
+    from PIL import Image
+    payload = table.column(imagenet.IMAGE_COLUMN)[0].as_py()
+    image = np.asarray(Image.open(io.BytesIO(payload)))
+    assert image.shape == (HEIGHT, WIDTH, 3)
+    assert image.dtype == np.uint8
+    # Seeded: regenerating gives identical bytes.
+    filenames2, _ = imagenet.generate_imagenet_parquet(
+        20, 2, tmp_parquet_dir + "2", height=HEIGHT, width=WIDTH,
+        num_classes=4, seed=7)
+    table2 = pq.read_table(filenames2[0])
+    assert table.equals(table2)
+
+
+def test_decode_transform_matches_source_pixels(tmp_parquet_dir):
+    filenames, _ = imagenet.generate_imagenet_parquet(
+        6, 1, tmp_parquet_dir, height=HEIGHT, width=WIDTH, num_classes=3)
+    table = pq.read_table(filenames[0])
+    decoded = imagenet.decode_transform(HEIGHT, WIDTH)(table)
+    # PNG is lossless: decoded pixels equal a direct PIL decode.
+    from PIL import Image
+    for i in range(table.num_rows):
+        want = np.asarray(
+            Image.open(io.BytesIO(
+                table.column(imagenet.IMAGE_COLUMN)[i].as_py())))
+        got = np.asarray(
+            decoded.column(imagenet.IMAGE_COLUMN)[i].as_py(),
+            dtype=np.uint8).reshape(HEIGHT, WIDTH, 3)
+        np.testing.assert_array_equal(got, want)
+    # Other columns pass through untouched.
+    assert decoded.column(imagenet.KEY_COLUMN).equals(
+        table.column(imagenet.KEY_COLUMN))
+
+
+def test_decode_transform_rejects_wrong_shape(tmp_parquet_dir):
+    filenames, _ = imagenet.generate_imagenet_parquet(
+        2, 1, tmp_parquet_dir, height=HEIGHT, width=WIDTH, num_classes=2)
+    table = pq.read_table(filenames[0])
+    with pytest.raises(ValueError, match="fixed shapes"):
+        imagenet.decode_transform(HEIGHT + 1, WIDTH)(table)
+
+
+def test_imagenet_e2e_decode_in_reducers(tmp_parquet_dir):
+    """Full pipeline: encoded shards -> shuffle (decode in reducers) ->
+    (batch, H, W, 3) uint8 device arrays -> one ResNet train step."""
+    num_images, batch_size, num_epochs = 48, 16, 2
+    filenames, _ = imagenet.generate_imagenet_parquet(
+        num_images, 3, tmp_parquet_dir, height=HEIGHT, width=WIDTH,
+        num_classes=2, seed=3)
+    spec = imagenet.imagenet_spec(HEIGHT, WIDTH)
+    ds = JaxShufflingDataset(
+        filenames, num_epochs=num_epochs, num_trainers=1,
+        batch_size=batch_size, rank=0, num_reducers=2, seed=11,
+        drop_last=False, **spec)
+
+    cfg = resnet.ResNetConfig(stage_sizes=(1,), width=8, num_classes=2,
+                              num_groups=4, compute_dtype=jnp.float32)
+    params = resnet.init(cfg, jax.random.key(0))
+    opt = optax.sgd(1e-2)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, images, labels):
+        loss, grads = jax.value_and_grad(lambda p: resnet.loss_fn(
+            cfg, p, images.astype(jnp.float32) / 255.0, labels))(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    total_rows = 0
+    for epoch in range(num_epochs):
+        ds.set_epoch(epoch)
+        for features, label in ds:
+            (image,) = features
+            assert image.shape == (image.shape[0], HEIGHT, WIDTH, 3)
+            assert image.dtype == jnp.uint8
+            assert label.dtype == jnp.int32
+            total_rows += image.shape[0]
+            params, opt_state, loss = step(params, opt_state, image, label)
+    assert total_rows == num_epochs * num_images
+    assert np.isfinite(float(loss))
+
+
+def test_decode_applies_to_empty_reducer_outputs(tmp_parquet_dir):
+    """More reducers than rows: 0-row reducer outputs must still get the
+    schema-changing decode, or the iterator's carry concat sees mixed
+    schemas and raises ArrowInvalid."""
+    filenames, _ = imagenet.generate_imagenet_parquet(
+        5, 1, tmp_parquet_dir, height=HEIGHT, width=WIDTH, num_classes=2)
+    ds = JaxShufflingDataset(
+        filenames, num_epochs=1, num_trainers=1, batch_size=2, rank=0,
+        num_reducers=8, drop_last=False, device_put=False,
+        **imagenet.imagenet_spec(HEIGHT, WIDTH))
+    ds.set_epoch(0)
+    total = sum(features[0].shape[0] for features, _ in ds)
+    assert total == 5
+
+
+def test_generate_tokenized_parquet(tmp_parquet_dir):
+    seq_len = 16
+    filenames, _ = bert_mlm.generate_tokenized_parquet(
+        30, 2, tmp_parquet_dir, seq_len=seq_len, vocab_size=100, seed=5)
+    table = pq.read_table(filenames[0])
+    tokens = np.asarray(table.column(bert_mlm.TOKENS_COLUMN).to_pylist())
+    assert tokens.shape[1] == seq_len
+    assert (tokens[:, 0] == bert_mlm.CLS_ID).all()
+    assert (tokens[:, -1] == bert_mlm.SEP_ID).all()
+    assert tokens.min() >= 0 and tokens.max() < 100
+
+
+def test_mlm_mask_properties():
+    vocab = 50
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(
+        rng.integers(bert_mlm.NUM_SPECIAL_TOKENS, vocab, (8, 64)),
+        dtype=jnp.int32).at[:, 0].set(bert_mlm.CLS_ID)
+    inputs, targets = jax.jit(
+        lambda t, k: bert_mlm.mlm_mask(t, k, vocab))(
+            tokens, jax.random.key(1))
+    inputs, targets = np.asarray(inputs), np.asarray(targets)
+    tokens = np.asarray(tokens)
+    selected = targets != IGNORE_ID
+    # Special tokens are never selected.
+    assert not selected[:, 0].any()
+    # Targets hold the ORIGINAL token at selected positions.
+    np.testing.assert_array_equal(targets[selected], tokens[selected])
+    # Unselected inputs pass through unchanged.
+    np.testing.assert_array_equal(inputs[~selected], tokens[~selected])
+    # Selection rate is ~15%.
+    rate = selected.mean()
+    assert 0.05 < rate < 0.30, rate
+    # Among selected: mostly [MASK], some random, some kept.
+    masked_frac = (inputs[selected] == bert_mlm.MASK_ID).mean()
+    assert 0.6 < masked_frac <= 0.95, masked_frac
+    # Different keys give different masks; same key replays exactly.
+    inputs2, _ = bert_mlm.mlm_mask(jnp.asarray(tokens), jax.random.key(2),
+                                   vocab)
+    assert (np.asarray(inputs2) != inputs).any()
+    inputs3, _ = bert_mlm.mlm_mask(jnp.asarray(tokens), jax.random.key(1),
+                                   vocab)
+    np.testing.assert_array_equal(np.asarray(inputs3), inputs)
+
+
+def test_bert_mlm_e2e_sequence_batching(tmp_parquet_dir):
+    """Full pipeline: tokenized shards -> shuffle -> (batch, seq) device
+    arrays -> on-device dynamic masking -> one BERT train step."""
+    seq_len, vocab, num_seqs, batch_size = 16, 64, 24, 8
+    filenames, _ = bert_mlm.generate_tokenized_parquet(
+        num_seqs, 2, tmp_parquet_dir, seq_len=seq_len, vocab_size=vocab,
+        seed=9)
+    ds = JaxShufflingDataset(
+        filenames, num_epochs=1, num_trainers=1, batch_size=batch_size,
+        rank=0, num_reducers=2, seed=13, drop_last=True,
+        **bert_mlm.bert_mlm_spec(seq_len))
+
+    cfg = bert.BertConfig(vocab_size=vocab, hidden_dim=16, num_layers=1,
+                          num_heads=2, ffn_dim=32, max_seq_len=seq_len,
+                          compute_dtype=jnp.float32)
+    params = bert.init(cfg, jax.random.key(0))
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def step(params, opt_state, tokens, key):
+        inputs, targets = bert_mlm.mlm_mask(tokens, key, vocab)
+        loss, grads = jax.value_and_grad(
+            lambda p: bert.loss_fn(cfg, p, inputs, targets))(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    ds.set_epoch(0)
+    steps = 0
+    for features, _label in ds:
+        (tokens,) = features
+        assert tokens.shape == (batch_size, seq_len)
+        assert tokens.dtype == jnp.int32
+        params, opt_state, loss = step(params, opt_state, tokens,
+                                       jax.random.key(steps))
+        steps += 1
+    assert steps == num_seqs // batch_size
+    assert np.isfinite(float(loss))
+
+
+def test_reduce_transform_exactly_once_per_row(tmp_parquet_dir):
+    """The reduce_transform hook sees every row exactly once per epoch."""
+    from ray_shuffling_data_loader_tpu import data_generation as dg
+    from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+    import threading
+
+    filenames, _ = dg.generate_data_local(200, 2, 1, 0.0, tmp_parquet_dir)
+    seen = []
+    lock = threading.Lock()
+
+    def spy(table):
+        with lock:
+            seen.extend(table.column(dg.KEY_COLUMN).to_pylist())
+        return table
+
+    ds = ShufflingDataset(filenames, num_epochs=1, num_trainers=1,
+                          batch_size=50, rank=0, num_reducers=3,
+                          reduce_transform=spy)
+    ds.set_epoch(0)
+    rows = sum(t.num_rows for t in ds)
+    assert rows == 200
+    assert sorted(seen) == list(range(200))
