@@ -42,6 +42,36 @@ logger = setup_custom_logger(__name__)
 MULTIQUEUE_NAME = "MultiQueue"
 
 
+class ShuffleFailure:
+    """Poison pill broadcast into every trainer queue when the shuffle
+    driver dies, so consumers blocked on ``queue.get`` raise immediately
+    instead of hanging forever (the reference has no equivalent; a dead
+    shuffle task strands its trainers)."""
+
+    __slots__ = ("error",)
+
+    def __init__(self, error: BaseException):
+        self.error = error
+
+
+def make_failure_broadcaster(batch_queue: mq.MultiQueue,
+                             num_queues: int):
+    """``on_failure`` hook for ``run_shuffle_in_background``: best-effort
+    non-blocking put of a :class:`ShuffleFailure` into every queue (bounded
+    queues that are full are skipped — their consumers will still drain to
+    the marker's slot eventually or hit the driver error at join)."""
+
+    def broadcast(error: BaseException) -> None:
+        marker = ShuffleFailure(error)
+        for queue_idx in range(num_queues):
+            try:
+                batch_queue.put_nowait(queue_idx, marker)
+            except (mq.Full, RuntimeError):
+                pass
+
+    return broadcast
+
+
 def batch_consumer(queue: mq.MultiQueue,
                    num_trainers: int,
                    rank: int,
@@ -78,7 +108,8 @@ def create_batch_queue_and_shuffle(
         queue_name: str = MULTIQUEUE_NAME,
         start_epoch: int = 0,
         map_transform=None,
-        reduce_transform=None):
+        reduce_transform=None,
+        task_retries: int = 0):
     """Driver-mode helper: create the queue and start the shuffle before any
     trainer exists, so every rank can be a pure consumer
     (reference: dataset.py:17-51)."""
@@ -105,7 +136,10 @@ def create_batch_queue_and_shuffle(
         collect_stats=False,
         start_epoch=start_epoch,
         map_transform=map_transform,
-        reduce_transform=reduce_transform)
+        reduce_transform=reduce_transform,
+        task_retries=task_retries,
+        on_failure=make_failure_broadcaster(batch_queue,
+                                            num_epochs * num_trainers))
     return batch_queue, shuffle_result
 
 
@@ -142,7 +176,8 @@ class ShufflingDataset:
                  queue_name: str = MULTIQUEUE_NAME,
                  start_epoch: int = 0,
                  map_transform=None,
-                 reduce_transform=None):
+                 reduce_transform=None,
+                 task_retries: int = 0):
         if num_reducers is None:
             num_reducers = default_num_reducers(num_trainers)
         self._batch_size = batch_size
@@ -158,7 +193,8 @@ class ShufflingDataset:
                         num_workers=num_workers, queue_name=queue_name,
                         start_epoch=start_epoch,
                         map_transform=map_transform,
-                        reduce_transform=reduce_transform))
+                        reduce_transform=reduce_transform,
+                        task_retries=task_retries))
                 self._owns_queue = True
             else:
                 self._batch_queue = mq.MultiQueue(
@@ -245,6 +281,10 @@ class ShufflingDataset:
             ref = self._batch_queue.get(queue_idx, block=True)
             if ref is None:
                 break
+            if isinstance(ref, ShuffleFailure):
+                raise RuntimeError(
+                    "the shuffle driver died; no more batches are coming"
+                ) from ref.error
             table: pa.Table = ref.result()
             if to_skip:
                 if table.num_rows <= to_skip:

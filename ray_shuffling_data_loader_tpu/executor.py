@@ -110,10 +110,19 @@ class Executor:
     """
 
     def __init__(self, num_workers: Optional[int] = None,
-                 thread_name_prefix: str = "rsdl-worker"):
+                 thread_name_prefix: str = "rsdl-worker",
+                 task_retries: int = 0):
+        """``task_retries``: re-run a task that raises up to N extra times
+        before surfacing the failure — the stand-in for Ray's implicit task
+        retry the reference leans on (SURVEY.md §5). Safe for shuffle tasks
+        because every random draw is keyed by (seed, epoch, task), so a
+        retried task reproduces its output exactly."""
         if num_workers is None:
             num_workers = os.cpu_count() or 4
+        if task_retries < 0:
+            raise ValueError(f"task_retries must be >= 0, got {task_retries}")
         self._num_workers = num_workers
+        self._task_retries = task_retries
         self._pool = cf.ThreadPoolExecutor(
             max_workers=num_workers, thread_name_prefix=thread_name_prefix)
         self._shutdown = False
@@ -125,7 +134,22 @@ class Executor:
     def submit(self, fn: Callable, *args, **kwargs) -> TaskRef:
         if self._shutdown:
             raise RuntimeError("executor is shut down")
+        if self._task_retries:
+            return TaskRef(self._pool.submit(self._run_with_retries, fn,
+                                             args, kwargs))
         return TaskRef(self._pool.submit(fn, *args, **kwargs))
+
+    def _run_with_retries(self, fn: Callable, args, kwargs) -> Any:
+        for attempt in range(self._task_retries + 1):
+            try:
+                return fn(*args, **kwargs)
+            except Exception as e:
+                if attempt == self._task_retries:
+                    raise
+                logger.warning(
+                    "task %s failed (attempt %d/%d): %s; retrying",
+                    getattr(fn, "__name__", fn), attempt + 1,
+                    self._task_retries + 1, e)
 
     def map(self, fn: Callable, items: Sequence) -> List[TaskRef]:
         return [self.submit(fn, item) for item in items]

@@ -206,7 +206,8 @@ def shuffle_distributed(filenames: Sequence[str],
                         start_epoch: int = 0,
                         map_transform=None,
                         file_cache="auto",
-                        reduce_transform=None) -> float:
+                        reduce_transform=None,
+                        task_retries: int = 0) -> float:
     """Multi-epoch pipelined distributed shuffle driver for ONE host.
 
     Run with the same arguments on every host of the world (SPMD); hosts
@@ -227,7 +228,8 @@ def shuffle_distributed(filenames: Sequence[str],
     start = timeit.default_timer()
     owns_pool = pool is None
     if pool is None:
-        pool = ex.Executor(num_workers=num_workers)
+        pool = ex.Executor(num_workers=num_workers,
+                           task_retries=task_retries)
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}
         for epoch_idx in range(start_epoch, num_epochs):
@@ -265,7 +267,8 @@ def create_distributed_batch_queue_and_shuffle(
         queue_name: Optional[str] = None,
         start_epoch: int = 0,
         map_transform=None,
-        reduce_transform=None) -> Tuple[mq.MultiQueue, ex.TaskRef]:
+        reduce_transform=None,
+        task_retries: int = 0) -> Tuple[mq.MultiQueue, ex.TaskRef]:
     """Host-local queue + background distributed shuffle driver.
 
     The returned ``(batch_queue, shuffle_result)`` plug straight into
@@ -275,10 +278,13 @@ def create_distributed_batch_queue_and_shuffle(
     the consumer-only pattern of the reference's distributed example
     (reference: dataset.py:17-51, ray_torch_shuffle.py:316-322).
     """
+    from ray_shuffling_data_loader_tpu.dataset import make_failure_broadcaster
     batch_queue = mq.MultiQueue(num_epochs * trainers_per_host,
                                 max_batch_queue_size, name=queue_name)
     consumer = functools.partial(queue_batch_consumer, batch_queue,
                                  trainers_per_host)
+    on_failure = make_failure_broadcaster(batch_queue,
+                                          num_epochs * trainers_per_host)
     driver_pool = ex.Executor(num_workers=1,
                               thread_name_prefix="rsdl-dist-driver")
 
@@ -290,7 +296,11 @@ def create_distributed_batch_queue_and_shuffle(
                 max_concurrent_epochs=max_concurrent_epochs, seed=seed,
                 num_workers=num_workers, start_epoch=start_epoch,
                 map_transform=map_transform,
-                reduce_transform=reduce_transform)
+                reduce_transform=reduce_transform,
+                task_retries=task_retries)
+        except BaseException as e:  # noqa: BLE001 - forwarded to consumers
+            on_failure(e)
+            raise
         finally:
             driver_pool.shutdown(wait_for_tasks=False)
 

@@ -73,7 +73,8 @@ class TcpTransport:
     """
 
     def __init__(self, host_id: int, addresses: Sequence[Tuple[str, int]],
-                 recv_timeout_s: float = 600.0):
+                 recv_timeout_s: float = 600.0,
+                 reconnect_grace_s: float = 5.0):
         if not 0 <= host_id < len(addresses):
             raise ValueError(
                 f"host_id {host_id} out of range for {len(addresses)} hosts")
@@ -81,9 +82,14 @@ class TcpTransport:
         self.addresses = list(addresses)
         self.world = len(addresses)
         self._recv_timeout_s = recv_timeout_s
+        self._reconnect_grace_s = reconnect_grace_s
         self._inbox: Dict[Tuple[int, Tag], bytes] = {}
         self._inbox_cv = threading.Condition()
-        self._dead_srcs: Dict[int, str] = {}  # src host id -> reason
+        # src host id -> (reason, death monotonic time). A src is revived
+        # (entry dropped) when a message arrives on a NEW connection — a
+        # sender that redials after a transient failure resumes seamlessly;
+        # recv() only fails a dead src after reconnect_grace_s.
+        self._dead_srcs: Dict[int, Tuple[str, float]] = {}
         self._peers: Dict[int, socket.socket] = {}
         self._peer_locks: Dict[int, threading.Lock] = {}
         self._listener: Optional[socket.socket] = None
@@ -200,16 +206,28 @@ class TcpTransport:
                 key = (src, (epoch, reducer, file_index))
                 with self._inbox_cv:
                     if key in self._inbox:
-                        raise TransportError(f"duplicate message for {key}")
-                    self._inbox[key] = payload
+                        # At-least-once delivery: a sender whose sendall
+                        # errored after the frame was in fact delivered
+                        # resends it on a fresh connection. Keep the first.
+                        logger.warning(
+                            "host %d: dropping duplicate message %s "
+                            "(sender resend after reconnect)",
+                            self.host_id, key)
+                    else:
+                        self._inbox[key] = payload
+                    # A live message revives a src a previous connection
+                    # declared dead (sender redialed).
+                    self._dead_srcs.pop(src, None)
                     self._inbox_cv.notify_all()
         except (TransportError, OSError) as e:
             if not self._closed.is_set():
-                # Fail pending/future recvs from these srcs fast instead of
-                # letting them sit out the full recv timeout.
+                # Fail pending/future recvs from these srcs fast (after the
+                # reconnect grace) instead of sitting out the recv timeout.
+                import time
+                now = time.monotonic()
                 with self._inbox_cv:
                     for src in srcs_seen:
-                        self._dead_srcs.setdefault(src, str(e))
+                        self._dead_srcs.setdefault(src, (str(e), now))
                     self._inbox_cv.notify_all()
                 logger.warning("host %d: peer connection died: %s",
                                self.host_id, e)
@@ -237,10 +255,16 @@ class TcpTransport:
                 if self._closed.is_set():
                     raise TransportError("transport closed while receiving")
                 if src in self._dead_srcs:
-                    raise TransportError(
-                        f"host {self.host_id}: connection from host {src} "
-                        f"died before message {tag} arrived: "
-                        f"{self._dead_srcs[src]}")
+                    reason, died_at = self._dead_srcs[src]
+                    # Give a redialing sender reconnect_grace_s to revive
+                    # the src before failing the trial.
+                    if (time.monotonic() - died_at
+                            >= self._reconnect_grace_s):
+                        raise TransportError(
+                            f"host {self.host_id}: connection from host "
+                            f"{src} died before message {tag} arrived "
+                            f"(no reconnect within "
+                            f"{self._reconnect_grace_s:.0f}s): {reason}")
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     raise TransportTimeout(
@@ -274,9 +298,32 @@ class TcpTransport:
             try:
                 sock.sendall(header)
                 sock.sendall(payload)
-            except OSError as e:
-                raise TransportError(
-                    f"host {self.host_id} failed sending to peer {dest}: {e}")
+            except OSError as first_err:
+                # Elastic path: one redial + resend. The receiver discards
+                # nothing on its side — a partial frame on the old
+                # connection kills only that connection's recv loop, and
+                # the resent frame arrives whole on the new one (the
+                # receiver revives the src on first message).
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+                try:
+                    new_sock = socket.create_connection(
+                        self.addresses[dest], timeout=30)
+                    new_sock.settimeout(None)
+                    new_sock.setsockopt(socket.IPPROTO_TCP,
+                                        socket.TCP_NODELAY, 1)
+                    self._peers[dest] = new_sock
+                    new_sock.sendall(header)
+                    new_sock.sendall(payload)
+                    logger.warning(
+                        "host %d: send to peer %d failed (%s); redialed and "
+                        "resent %s", self.host_id, dest, first_err, tag)
+                except OSError as e:
+                    raise TransportError(
+                        f"host {self.host_id} failed sending to peer {dest} "
+                        f"(redial also failed: {e}): {first_err}")
 
 
 def create_local_transports(world: int,

@@ -447,8 +447,8 @@ def shuffle(filenames: Sequence[str],
             start_epoch: int = 0,
             map_transform: Optional[MapTransform] = None,
             file_cache: Union[FileTableCache, None, str] = "auto",
-            reduce_transform: Optional[ReduceTransform] = None
-            ) -> Union[stats_mod.TrialStats, float]:
+            reduce_transform: Optional[ReduceTransform] = None,
+            task_retries: int = 0) -> Union[stats_mod.TrialStats, float]:
     """Multi-epoch pipelined shuffle driver (reference: shuffle.py:79-160).
 
     Keeps at most ``max_concurrent_epochs`` epochs' shuffles in flight:
@@ -484,7 +484,8 @@ def shuffle(filenames: Sequence[str],
                       if num_epochs - start_epoch > 1 else None)
     owns_pool = pool is None
     if pool is None:
-        pool = ex.Executor(num_workers=num_workers)
+        pool = ex.Executor(num_workers=num_workers,
+                           task_retries=task_retries)
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}
         for epoch_idx in range(start_epoch, num_epochs):
@@ -579,12 +580,21 @@ def run_shuffle_in_background(
         start_epoch: int = 0,
         map_transform: Optional[MapTransform] = None,
         file_cache: Union[FileTableCache, None, str] = "auto",
-        reduce_transform: Optional[ReduceTransform] = None) -> ex.TaskRef:
+        reduce_transform: Optional[ReduceTransform] = None,
+        task_retries: int = 0,
+        on_failure: Optional[Callable[[BaseException], None]] = None
+        ) -> ex.TaskRef:
     """Launch the whole multi-epoch shuffle as one background task.
 
     Stands in for the reference driver's ``ray.remote(shuffle).remote(...)``
     (reference: dataset.py:110-118): the returned TaskRef is the
     ``shuffle_result`` handle the dataset joins after the last epoch.
+
+    ``on_failure`` is invoked (once, from the driver thread) if the shuffle
+    dies, BEFORE the error is stored in the returned ref — the dataset layer
+    uses it to poison-pill trainer queues so blocked consumers fail fast
+    instead of hanging (the reference has no equivalent: a dead Ray shuffle
+    task leaves trainers blocked on the queue actor forever).
     """
     # A dedicated single-worker executor hosts the driver loop so it never
     # competes with map/reduce workers for a pool slot.
@@ -599,7 +609,15 @@ def run_shuffle_in_background(
                            start_epoch=start_epoch,
                            map_transform=map_transform,
                            file_cache=file_cache,
-                           reduce_transform=reduce_transform)
+                           reduce_transform=reduce_transform,
+                           task_retries=task_retries)
+        except BaseException as e:  # noqa: BLE001 - forwarded to consumers
+            if on_failure is not None:
+                try:
+                    on_failure(e)
+                except Exception:  # noqa: BLE001
+                    logger.exception("shuffle on_failure hook itself failed")
+            raise
         finally:
             driver_pool.shutdown(wait_for_tasks=False)
 

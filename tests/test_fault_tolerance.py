@@ -1,0 +1,219 @@
+"""Fault injection: task retries, fail-fast consumers, transport recovery.
+
+The reference leans on Ray's implicit task retry and named-actor reconnect
+(SURVEY.md §5: "failure detection"); these tests pin down our equivalents —
+executor task_retries, the ShuffleFailure poison pill, and the TCP
+transport's redial/revival path — by injecting real failures."""
+
+import socket
+import threading
+import time
+
+import pytest
+
+import importlib
+
+from ray_shuffling_data_loader_tpu import data_generation as dg
+from ray_shuffling_data_loader_tpu import executor as ex
+
+# The package __init__ rebinds the ``shuffle`` attribute to the function.
+sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+from ray_shuffling_data_loader_tpu.parallel import transport as tr
+
+
+class Flaky:
+    """Callable that raises its first ``failures`` invocations."""
+
+    def __init__(self, failures, exc=RuntimeError("injected")):
+        self.failures = failures
+        self.calls = 0
+        self.exc = exc
+        self.lock = threading.Lock()
+
+    def __call__(self, value=None):
+        with self.lock:
+            self.calls += 1
+            if self.calls <= self.failures:
+                raise self.exc
+        return value
+
+
+def test_executor_retries_transient_failure():
+    flaky = Flaky(2)
+    with ex.Executor(num_workers=1, task_retries=2) as pool:
+        assert pool.submit(flaky, 42).result() == 42
+    assert flaky.calls == 3
+
+
+def test_executor_exhausted_retries_raise():
+    flaky = Flaky(3)
+    with ex.Executor(num_workers=1, task_retries=2) as pool:
+        with pytest.raises(RuntimeError, match="injected"):
+            pool.submit(flaky).result()
+    assert flaky.calls == 3
+
+
+def test_executor_no_retries_by_default():
+    flaky = Flaky(1)
+    with ex.Executor(num_workers=1) as pool:
+        with pytest.raises(RuntimeError, match="injected"):
+            pool.submit(flaky).result()
+    assert flaky.calls == 1
+
+
+def test_shuffle_survives_flaky_map_with_retries(tmp_parquet_dir):
+    """A map stage that fails transiently completes under task_retries and
+    still produces every key exactly once."""
+    filenames, _ = dg.generate_data_local(120, 3, 1, 0.0, tmp_parquet_dir)
+    flaky = Flaky(2)
+
+    def flaky_transform(table):
+        flaky()
+        return table
+
+    collected = []
+    lock = threading.Lock()
+
+    def consumer(rank, epoch, refs):
+        if refs is not None:
+            with lock:
+                collected.extend(refs)
+
+    duration = sh.shuffle(filenames, consumer, num_epochs=1, num_reducers=2,
+                          num_trainers=1, collect_stats=False,
+                          map_transform=flaky_transform, file_cache=None,
+                          task_retries=2)
+    assert duration > 0
+    keys = sorted(k for ref in collected
+                  for k in ref.result().column(dg.KEY_COLUMN).to_pylist())
+    assert keys == list(range(120))
+    assert flaky.calls >= 3  # the injected failures really happened
+
+
+def _iterate_in_thread(ds, epoch):
+    ds.set_epoch(epoch)
+    result = {}
+
+    def iterate():
+        try:
+            for _ in ds:
+                pass
+            result["outcome"] = "completed"
+        except BaseException as e:  # noqa: BLE001
+            result["outcome"] = e
+
+    thread = threading.Thread(target=iterate, daemon=True)
+    thread.start()
+    thread.join(timeout=30)
+    assert not thread.is_alive(), "iterator hung on a dead shuffle driver"
+    return result["outcome"]
+
+
+def test_dataset_fails_fast_on_enqueued_task_failure(tmp_parquet_dir):
+    """Failed map/reduce refs already routed to the trainer propagate the
+    original error straight out of the iterator."""
+    filenames, _ = dg.generate_data_local(100, 2, 1, 0.0, tmp_parquet_dir)
+
+    def always_fails(table):
+        raise ValueError("injected map failure")
+
+    ds = ShufflingDataset(filenames, num_epochs=2, num_trainers=1,
+                          batch_size=10, rank=0, num_reducers=2,
+                          map_transform=always_fails,
+                          queue_name="MQ-fail-fast-refs")
+    outcome = _iterate_in_thread(ds, epoch=0)
+    assert isinstance(outcome, ValueError), outcome
+
+
+def test_dataset_fails_fast_on_never_shuffled_epoch(tmp_parquet_dir):
+    """An epoch whose shuffle never launched (driver died first) has an
+    empty queue; the ShuffleFailure poison pill unblocks the iterator with
+    a RuntimeError chaining the root cause."""
+    filenames, _ = dg.generate_data_local(100, 2, 1, 0.0, tmp_parquet_dir)
+
+    def always_fails(table):
+        raise ValueError("injected map failure")
+
+    ds = ShufflingDataset(filenames, num_epochs=4, num_trainers=1,
+                          batch_size=10, rank=0, num_reducers=2,
+                          max_concurrent_epochs=1,
+                          map_transform=always_fails,
+                          queue_name="MQ-fail-fast-pill")
+    # Epoch 3 is never launched: the driver dies draining epoch 0.
+    outcome = _iterate_in_thread(ds, epoch=3)
+    assert isinstance(outcome, RuntimeError), outcome
+    assert isinstance(outcome.__cause__, ValueError)
+
+
+def _tag(i=0):
+    return (0, i, 0)
+
+
+def test_transport_send_redials_after_connection_loss():
+    t0, t1 = tr.create_local_transports(2)
+    try:
+        t0.send(1, _tag(0), b"before")
+        assert t1.recv(0, _tag(0), timeout_s=10) == b"before"
+        # Sever the established sender-side connection.
+        t0._peers[1].shutdown(socket.SHUT_RDWR)
+        t0._peers[1].close()
+        t0.send(1, _tag(1), b"after-redial")
+        assert t1.recv(0, _tag(1), timeout_s=10) == b"after-redial"
+    finally:
+        t0.close()
+        t1.close()
+
+
+def _kill_connection_mid_message(sender, receiver_host=1):
+    """Send a truncated frame so the receiver marks the src dead."""
+    header = tr._HEADER.pack(tr._MAGIC, sender.host_id, 9, 9, 9, 100)
+    sock = sender._peers[receiver_host]
+    sock.sendall(header + b"only-a-few-bytes")
+    sock.shutdown(socket.SHUT_RDWR)
+    sock.close()
+
+
+def test_transport_recv_fails_after_reconnect_grace():
+    t0, t1 = tr.create_local_transports(2)
+    t1._reconnect_grace_s = 0.3
+    try:
+        t0.send(1, _tag(0), b"x")  # so the recv loop has seen src 0
+        assert t1.recv(0, _tag(0), timeout_s=10) == b"x"
+        _kill_connection_mid_message(t0)
+        start = time.monotonic()
+        with pytest.raises(tr.TransportError, match="died before message"):
+            t1.recv(0, _tag(7), timeout_s=30)
+        # Failed fast (grace + cv poll), nowhere near the 30s timeout.
+        assert time.monotonic() - start < 10
+    finally:
+        t0.close()
+        t1.close()
+
+
+def test_transport_sender_revives_dead_src_within_grace():
+    """After a mid-message connection death, a redialing sender's next
+    message revives the src: pending recv succeeds instead of raising."""
+    t0, t1 = tr.create_local_transports(2)
+    t1._reconnect_grace_s = 30.0
+    try:
+        t0.send(1, _tag(0), b"x")
+        assert t1.recv(0, _tag(0), timeout_s=10) == b"x"
+        _kill_connection_mid_message(t0)
+        # Wait until the receiver has marked src 0 dead.
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            with t1._inbox_cv:
+                if 0 in t1._dead_srcs:
+                    break
+            time.sleep(0.01)
+        else:
+            pytest.fail("receiver never noticed the dead connection")
+        # Sender comes back (send() redials internally) and delivers.
+        t0.send(1, _tag(2), b"revived")
+        assert t1.recv(0, _tag(2), timeout_s=10) == b"revived"
+        with t1._inbox_cv:
+            assert 0 not in t1._dead_srcs
+    finally:
+        t0.close()
+        t1.close()
