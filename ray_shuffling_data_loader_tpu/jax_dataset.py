@@ -214,9 +214,12 @@ class JaxShufflingDataset:
             True — unlike the reference.
         stack_features: yield features as ONE ``(batch, num_features)``
             device array instead of a list of ``(batch, 1)`` arrays.
-            Requires identical feature dtypes and scalar/1-wide shapes.
-            One host->device transfer per batch instead of one per column —
-            this is the layout DLRM-style models consume anyway.
+            Requires identical feature dtypes and scalar/1-wide shapes —
+            this is the layout DLRM-style models consume anyway. With
+            ``device_put`` the stack happens ON DEVICE (columns are
+            transferred zero-copy and concatenated by one fused XLA op), so
+            the host never pays the strided ``np.concatenate`` pass — on
+            TPU the concat rides HBM bandwidth instead of host memory.
         cast_at_map: cast spec'd columns to their final dtypes at the map
             stage (before shuffling) instead of per batch — see
             :func:`make_cast_transform`. Only effective when this dataset
@@ -291,6 +294,7 @@ class JaxShufflingDataset:
         self._data_axis = data_axis
         self._prefetch_size = max(1, prefetch_size)
         self._device_put = device_put
+        self._device_concat = None  # jitted column concat, built lazily
         self.batch_wait_stats = BatchWaitStats()
 
     def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
@@ -316,30 +320,40 @@ class JaxShufflingDataset:
             self._mesh, P(self._data_axis, *([None] * (ndim - 1))))
 
     def _transfer(self, arrays_label):
-        """Host arrays -> device arrays (sharded if a mesh was given)."""
+        """Host arrays -> device arrays (sharded if a mesh was given).
+
+        With ``stack_features``, per-column host arrays are transferred
+        individually (zero-copy views of the Arrow buffers) and stacked by
+        one jitted ``jnp.concatenate`` on device — the host-side strided
+        interleave this replaces was a top host cost of the ingest path.
+        """
         import jax
         features, label = arrays_label
         if not self._device_put:
+            if self._stack_features:
+                features = (features[0] if len(features) == 1
+                            else np.concatenate(features, axis=1))
             return features, label
-        if isinstance(features, np.ndarray):  # stacked
-            out_features = jax.device_put(features,
-                                          self._sharding(features.ndim))
-        else:
-            out_features = [
-                jax.device_put(a, self._sharding(a.ndim)) for a in features
-            ]
+        out_features = [
+            jax.device_put(a, self._sharding(a.ndim)) for a in features
+        ]
+        if self._stack_features:
+            if len(out_features) == 1:
+                out_features = out_features[0]
+            else:
+                if self._device_concat is None:
+                    import jax.numpy as jnp
+                    self._device_concat = jax.jit(
+                        lambda cols: jnp.concatenate(cols, axis=1))
+                out_features = self._device_concat(out_features)
         out_label = jax.device_put(label, self._sharding(label.ndim))
         return out_features, out_label
 
     def _convert(self, table: pa.Table):
-        features, label = convert_to_arrays(
+        return convert_to_arrays(
             table, self._feature_columns, self._feature_shapes,
             self._feature_types, self._label_column, self._label_shape,
             self._label_type)
-        if self._stack_features:
-            features = (features[0] if len(features) == 1
-                        else np.concatenate(features, axis=1))
-        return features, label
 
     def __iter__(self) -> Iterator[Tuple[List[Any], Any]]:
         """Yield ``(features, label)`` device batches.
