@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ray_shuffling_data_loader_tpu.models import mlp as mlp_mod
+from ray_shuffling_data_loader_tpu.ops import embedding
 
 # The reference DATA_SPEC's categorical cardinalities
 # (reference: data_generation.py:74-95): 17 embedding columns + 2 one-hots.
@@ -46,6 +47,10 @@ class DLRMConfig:
     bottom_hidden: Tuple[int, ...] = (64,)
     top_hidden: Tuple[int, ...] = (512, 256)
     compute_dtype: Any = jnp.bfloat16
+    # Embedding lookup strategy (ops/embedding.py): "auto" sends small
+    # tables through a one-hot MXU matmul and large ones through XLA
+    # gather; "pallas" opts into the scalar-prefetch Pallas kernel.
+    lookup_mode: str = "auto"
 
     @property
     def num_sparse(self) -> int:
@@ -122,14 +127,14 @@ def apply(config: DLRMConfig, params: Dict[str, Any],
     """Forward: sparse (batch, num_sparse) int32 indices,
     dense (batch, dense_dim) or None. Returns (batch, 1) f32 logits."""
     dtype = config.compute_dtype
-    # One embedding lookup per feature; XLA fuses the gathers. Tables are
-    # stacked feature-wise in the interaction tensor.
+    # One embedding lookup per feature (ops/embedding.py picks the hardware
+    # path per table size). Tables are stacked feature-wise afterwards.
     vectors = []
     for i in range(config.num_sparse):
-        table = params["embeddings"][f"table_{i}"].astype(dtype)
-        # mode="clip": JAX's default out-of-bounds gather fills NaN; clipping
-        # keeps a stray bad index from poisoning the whole step.
-        vectors.append(jnp.take(table, sparse[:, i], axis=0, mode="clip"))
+        vectors.append(
+            embedding.lookup(params["embeddings"][f"table_{i}"],
+                             sparse[:, i], dtype,
+                             mode=config.lookup_mode))
     if config.dense_dim > 0:
         bottom_cfg = _mlp_cfg(config.dense_dim, config.bottom_hidden,
                               config.embed_dim, dtype)

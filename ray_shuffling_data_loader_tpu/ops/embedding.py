@@ -1,0 +1,167 @@
+"""TPU embedding lookup strategies for DLRM-style sparse features.
+
+The reference never runs a real model (its train step is mocked,
+reference: ray_torch_shuffle.py:199-204); our DLRM flagship does 19 table
+lookups per step (models/dlrm.py), so the lookup is the model's hot
+non-matmul op. Three strategies, dispatched by :func:`lookup`:
+
+- ``take``: ``jnp.take(..., mode="clip")`` — XLA's native gather.
+- ``one_hot``: encode indices as a ``(batch, vocab)`` one-hot and matmul
+  with the table. Random-access gathers underuse the TPU (they issue from
+  the scalar/vector units against 512-byte HBM granules); a one-hot matmul
+  rides the MXU's systolic array instead. For small vocabularies the
+  (batch x vocab) FLOP waste is far cheaper than the gather's latency —
+  the standard TPU trick for small embedding tables. Exact: each output
+  row is 1.0 times one table row, so even bf16 results match the gather
+  bit-for-bit.
+- ``pallas``: a Pallas kernel using ``PrefetchScalarGridSpec`` — indices
+  are scalar-prefetched into SMEM so each grid step's BlockSpec index_map
+  selects the table row to DMA HBM->VMEM, overlapping row fetches with the
+  pipeline. Backward is an XLA scatter-add via ``custom_vjp``.
+
+``auto`` picks ``one_hot`` for vocab <= ONE_HOT_MAX_VOCAB else ``take``
+(the Pallas path is opt-in until it wins on-chip benchmarks:
+benchmarks/bench_embedding.py measures all three).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+# Above this vocab size the one-hot matmul's wasted FLOPs and VMEM
+# pressure outgrow the gather's latency; 2048 keeps the one-hot tile
+# within a few MXU passes at typical batch sizes.
+ONE_HOT_MAX_VOCAB = 2048
+
+
+def take_lookup(table: jax.Array, indices: jax.Array,
+                dtype: Any) -> jax.Array:
+    """XLA gather. mode="clip" so a stray bad index cannot NaN the step
+    (models/dlrm.py validates batches host-side instead)."""
+    return jnp.take(table.astype(dtype), indices, axis=0, mode="clip")
+
+
+def one_hot_lookup(table: jax.Array, indices: jax.Array,
+                   dtype: Any) -> jax.Array:
+    """(batch, vocab) one-hot @ (vocab, embed) on the MXU."""
+    vocab = table.shape[0]
+    indices = jnp.clip(indices, 0, vocab - 1)
+    one_hot = jax.nn.one_hot(indices, vocab, dtype=dtype)
+    return one_hot @ table.astype(dtype)
+
+
+# Output rows gathered per grid step. 8 = the float32 sublane tile, the
+# minimum legal block height; it also bounds in-flight row DMAs per step.
+_GATHER_BLOCK = 8
+
+
+def _pallas_gather_impl(table: jax.Array, indices: jax.Array,
+                        interpret: bool) -> jax.Array:
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _, embed_dim = table.shape
+    batch = indices.shape[0]
+    padded = ((batch + _GATHER_BLOCK - 1) // _GATHER_BLOCK) * _GATHER_BLOCK
+    if padded != batch:
+        indices = jnp.pad(indices, (0, padded - batch))
+
+    def kernel(idx_ref, table_ref, out_ref, sems):
+        i = pl.program_id(0)
+        # Issue all row DMAs of this block back-to-back (HBM -> this
+        # step's VMEM output block), then wait — the copies overlap.
+        dmas = []
+        for j in range(_GATHER_BLOCK):
+            row = idx_ref[i * _GATHER_BLOCK + j]
+            dma = pltpu.make_async_copy(
+                table_ref.at[pl.ds(row, 1), :],
+                out_ref.at[pl.ds(j, 1), :],
+                sems.at[j])
+            dma.start()
+            dmas.append(dma)
+        for dma in dmas:
+            dma.wait()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(padded // _GATHER_BLOCK,),
+        in_specs=[
+            # The table never enters VMEM wholesale; rows are DMA'd on
+            # demand straight out of HBM.
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((_GATHER_BLOCK, embed_dim),
+                               lambda i, idx_ref: (i, 0)),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((_GATHER_BLOCK,))],
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((padded, embed_dim), table.dtype),
+        interpret=interpret,
+    )(indices, table)
+    return out[:batch] if padded != batch else out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _pallas_gather(table: jax.Array, indices: jax.Array,
+                   interpret: bool) -> jax.Array:
+    return _pallas_gather_impl(table, indices, interpret)
+
+
+def _pallas_gather_fwd(table, indices, interpret):
+    return _pallas_gather_impl(table, indices, interpret), (
+        indices, table.shape[0])
+
+
+def _pallas_gather_bwd(interpret, residual, cotangent):
+    indices, vocab = residual
+    d_table = jnp.zeros((vocab, cotangent.shape[-1]),
+                        cotangent.dtype).at[indices].add(cotangent)
+    return d_table, None
+
+
+_pallas_gather.defvjp(_pallas_gather_fwd, _pallas_gather_bwd)
+
+
+def pallas_lookup(table: jax.Array, indices: jax.Array,
+                  dtype: Any) -> jax.Array:
+    """Pallas scalar-prefetch row gather (interpret mode off-TPU).
+
+    On real TPUs Mosaic requires HBM row-slice DMAs to be 128-lane
+    aligned, so tables whose embed dim is not a multiple of 128 fall back
+    to the XLA gather (numerically identical).
+    """
+    vocab, embed_dim = table.shape
+    interpret = jax.default_backend() != "tpu"
+    if not interpret and embed_dim % 128 != 0:
+        return take_lookup(table, indices, dtype)
+    indices = jnp.clip(indices.astype(jnp.int32), 0, vocab - 1)
+    # Gather in the table's storage dtype and cast afterwards: Mosaic
+    # supports single-row HBM DMAs for 4-byte types but not 2-byte ones,
+    # and cast-then-gather == gather-then-cast elementwise.
+    return _pallas_gather(table, indices, interpret).astype(dtype)
+
+
+def lookup(table: jax.Array,
+           indices: jax.Array,
+           dtype: Any,
+           mode: str = "auto") -> jax.Array:
+    """Embedding lookup: ``table (vocab, embed)``, ``indices (batch,)`` ->
+    ``(batch, embed)`` in ``dtype``. All modes clip out-of-range indices
+    and return bit-identical results; they differ only in which hardware
+    unit does the work."""
+    if mode == "auto":
+        mode = ("one_hot" if table.shape[0] <= ONE_HOT_MAX_VOCAB else "take")
+    if mode == "take":
+        return take_lookup(table, indices, dtype)
+    if mode == "one_hot":
+        return one_hot_lookup(table, indices, dtype)
+    if mode == "pallas":
+        return pallas_lookup(table, indices, dtype)
+    raise ValueError(
+        f"unknown lookup mode {mode!r}; expected auto/take/one_hot/pallas")
