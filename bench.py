@@ -120,19 +120,22 @@ def main() -> None:
 
     # Warm-up epoch 0 separately to exclude one-time compile cost (with a
     # single epoch there is no warm-up and compile time is included).
+    # RSDL_PROFILE_DIR=/tmp/tr captures a JAX profiler trace of the run.
+    from ray_shuffling_data_loader_tpu.utils.tracing import maybe_profile
     rows_consumed = 0
     start = timeit.default_timer()
     last = None
-    for epoch in range(num_epochs):
-        ds.set_epoch(epoch)
-        for features, label in ds:
-            last = touch(features, label)
-            if epoch > 0 or num_epochs == 1:
-                rows_consumed += label.shape[0]
-        if epoch == 0 and num_epochs > 1:
-            jax.block_until_ready(last)
-            start = timeit.default_timer()
-    jax.block_until_ready(last)
+    with maybe_profile():
+        for epoch in range(num_epochs):
+            ds.set_epoch(epoch)
+            for features, label in ds:
+                last = touch(features, label)
+                if epoch > 0 or num_epochs == 1:
+                    rows_consumed += label.shape[0]
+            if epoch == 0 and num_epochs > 1:
+                jax.block_until_ready(last)
+                start = timeit.default_timer()
+        jax.block_until_ready(last)
     duration = max(timeit.default_timer() - start, 1e-9)
     pipeline_rows_per_s = rows_consumed / duration
 

@@ -34,6 +34,7 @@ import pyarrow as pa
 from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
 from ray_shuffling_data_loader_tpu.stats import BatchWaitStats
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
 
 logger = setup_custom_logger(__name__)
 
@@ -386,7 +387,11 @@ class JaxShufflingDataset:
         def producer():
             try:
                 for table in self._dataset:
-                    if not _put(self._transfer(self._convert(table))):
+                    with trace_span("batch_convert"):
+                        arrays = self._convert(table)
+                    with trace_span("batch_transfer"):
+                        batch = self._transfer(arrays)
+                    if not _put(batch):
                         return
                 _put(SENTINEL)
             except BaseException as e:  # noqa: BLE001 - forwarded to consumer

@@ -26,6 +26,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ray_shuffling_data_loader_tpu.parallel.mesh import DATA_AXIS
+from ray_shuffling_data_loader_tpu.utils import tracing
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
@@ -78,11 +79,14 @@ class SpmdTrainer:
         step = make_train_step(loss_fn, optimizer)
         self._step = jax.jit(
             step, donate_argnums=(0, 1) if donate else ())
+        self._step_count = 0
 
     def train_step(self, *batch) -> jax.Array:
         """One optimizer step; returns the (lazy) scalar loss."""
-        self.params, self.opt_state, loss = self._step(
-            self.params, self.opt_state, *batch)
+        with tracing.step_span(self._step_count):
+            self.params, self.opt_state, loss = self._step(
+                self.params, self.opt_state, *batch)
+        self._step_count += 1
         return loss
 
     def block_until_ready(self) -> None:

@@ -42,6 +42,7 @@ from ray_shuffling_data_loader_tpu import executor as ex
 from ray_shuffling_data_loader_tpu import stats as stats_mod
 from ray_shuffling_data_loader_tpu.ops import partition as ops
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
 
 logger = setup_custom_logger(__name__)
 
@@ -228,21 +229,22 @@ def shuffle_map(filename: str,
     if stats_collector is not None:
         stats_collector.map_start(epoch)
     start = timeit.default_timer()
-    table = file_cache.get(filename) if file_cache is not None else None
-    if table is None:
-        table = pq.read_table(filename)
-        if map_transform is not None:
-            table = map_transform(table)
-        if file_cache is not None:
-            # Single-chunk columns => every later epoch's numpy views of
-            # this table are zero-copy.
-            table = table.combine_chunks()
-            file_cache.put(filename, table)
-    end_read = timeit.default_timer()
-    rng = ops.map_rng(seed, epoch, file_index)
-    assignments = ops.assign_reducers(table.num_rows, num_reducers, rng)
-    index_parts = ops.partition_indices(assignments, num_reducers)
-    shard = MapShard(table, index_parts)
+    with trace_span(f"shuffle_map e{epoch} f{file_index}"):
+        table = file_cache.get(filename) if file_cache is not None else None
+        if table is None:
+            table = pq.read_table(filename)
+            if map_transform is not None:
+                table = map_transform(table)
+            if file_cache is not None:
+                # Single-chunk columns => every later epoch's numpy views of
+                # this table are zero-copy.
+                table = table.combine_chunks()
+                file_cache.put(filename, table)
+        end_read = timeit.default_timer()
+        rng = ops.map_rng(seed, epoch, file_index)
+        assignments = ops.assign_reducers(table.num_rows, num_reducers, rng)
+        index_parts = ops.partition_indices(assignments, num_reducers)
+        shard = MapShard(table, index_parts)
     if stats_collector is not None:
         stats_collector.map_done(epoch, timeit.default_timer() - start,
                                  end_read - start)
@@ -321,6 +323,16 @@ def shuffle_reduce(reduce_index: int,
     if stats_collector is not None:
         stats_collector.reduce_start(epoch)
     start = timeit.default_timer()
+    with trace_span(f"shuffle_reduce e{epoch} r{reduce_index}"):
+        shuffled = _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
+                                        reduce_transform)
+    if stats_collector is not None:
+        stats_collector.reduce_done(epoch, timeit.default_timer() - start)
+    return shuffled
+
+
+def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
+                         reduce_transform):
     shuffled = None
     sources = []
     schema = None
@@ -361,8 +373,6 @@ def shuffle_reduce(reduce_index: int,
     # iterator's carry-buffer concat breaks on the mixed schemas.
     if reduce_transform is not None and shuffled.num_columns:
         shuffled = reduce_transform(shuffled)
-    if stats_collector is not None:
-        stats_collector.reduce_done(epoch, timeit.default_timer() - start)
     return shuffled
 
 

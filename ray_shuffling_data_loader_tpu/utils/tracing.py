@@ -1,0 +1,81 @@
+"""Stage-span tracing bridged into the JAX/XLA profiler.
+
+The reference's only tracing is wall-clock spans recorded into a stats
+actor (reference: shuffle.py:204-263, stats.py:68-246); device time is
+invisible to it. Here every hot stage (map, reduce, consume, convert,
+transfer, train step) is wrapped in a ``jax.profiler.TraceAnnotation`` so
+a captured trace shows the host pipeline stages on the same timeline as
+XLA device ops — the stall analysis the reference can't do: you SEE
+whether the device waits on the loader or vice versa.
+
+Zero-cost by default: annotations are no-ops until a trace is active.
+Capture is explicit (:func:`profile_trace`) or env-driven
+(``RSDL_PROFILE_DIR=/tmp/trace python ...`` via :func:`maybe_profile`);
+view with TensorBoard's profile plugin or Perfetto.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Iterator, Optional
+
+_trace_annotation = None
+
+
+def _get_trace_annotation():
+    """Lazy import: keep jax out of pure-host code paths until needed."""
+    global _trace_annotation
+    if _trace_annotation is None:
+        try:
+            from jax.profiler import TraceAnnotation
+            _trace_annotation = TraceAnnotation
+        except ImportError:  # pragma: no cover - jax is a hard dep in CI
+            _trace_annotation = False
+    return _trace_annotation
+
+
+@contextlib.contextmanager
+def trace_span(name: str) -> Iterator[None]:
+    """Named host span, visible in captured profiler traces. No-op cheap
+    when no trace is active; safe to call from worker threads."""
+    annotation = _get_trace_annotation()
+    if not annotation:
+        yield
+        return
+    with annotation(name):
+        yield
+
+
+def step_span(step: int):
+    """Train-step marker: lets the profiler group device ops per step.
+    Returns a context manager."""
+    try:
+        from jax.profiler import StepTraceAnnotation
+    except ImportError:  # pragma: no cover
+        return contextlib.nullcontext()
+    return StepTraceAnnotation("train", step_num=step)
+
+
+@contextlib.contextmanager
+def profile_trace(log_dir: str) -> Iterator[None]:
+    """Capture a JAX profiler trace (host spans + device timeline) into
+    ``log_dir`` for the duration of the block."""
+    import jax
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def maybe_profile(env_var: str = "RSDL_PROFILE_DIR") -> Iterator[None]:
+    """Capture a trace iff the env var names a directory — the zero-code
+    way to profile any run: ``RSDL_PROFILE_DIR=/tmp/tr python bench.py``."""
+    log_dir: Optional[str] = os.environ.get(env_var)
+    if not log_dir:
+        yield
+        return
+    with profile_trace(log_dir):
+        yield
