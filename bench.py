@@ -68,6 +68,7 @@ def main() -> None:
     else:
         import jax
 
+    import jax.numpy as jnp
     import numpy as np
 
     from ray_shuffling_data_loader_tpu import data_generation as datagen
@@ -104,19 +105,37 @@ def main() -> None:
     # pipelines read/partition/permute stages against consumption.
     num_reducers = max(4, default_num_reducers(num_trainers=1))
 
+    # Narrowest dtype per column that covers its cardinality
+    # (data_generation DATA_SPEC): cast at the map stage, so every
+    # downstream byte — partition, permute-gather, re-batch, host->HBM
+    # DMA — is 43B/row instead of 76B. Indices widen for free on device.
+    def narrow_dtype(high):
+        if high <= 127:
+            return np.int8
+        if high <= 32767:
+            return np.int16
+        return np.int32
+
+    feature_types = [
+        narrow_dtype(datagen.DATA_SPEC[c][1])
+        for c in datagen.FEATURE_COLUMNS
+    ]
+
     ds = JaxShufflingDataset(
         filenames, num_epochs=num_epochs, num_trainers=1,
         batch_size=batch_size, rank=0,
         feature_columns=list(datagen.FEATURE_COLUMNS),
-        feature_types=[np.int32] * len(datagen.FEATURE_COLUMNS),
+        feature_types=feature_types,
         label_column=datagen.LABEL_COLUMN,
         num_reducers=num_reducers, max_concurrent_epochs=2, seed=0,
-        queue_name="bench-queue", drop_last=True, stack_features=True)
+        queue_name="bench-queue", drop_last=True)
 
     # Tiny jitted reduction per batch: forces the batch to land on device;
-    # negligible compute. stack_features=True means ONE (batch, n_features)
-    # transfer per batch instead of one per column — the DLRM input layout.
-    touch = jax.jit(lambda f, y: f.sum() + y.sum())
+    # negligible compute (sparse-feature columns arrive as one pytree
+    # transfer and are consumed per-column, the DLRM access pattern).
+    touch = jax.jit(
+        lambda fs, y: sum(f.sum(dtype=jnp.int32) for f in fs)
+        + y.sum(dtype=jnp.float32))
 
     # Warm-up epoch 0 separately to exclude one-time compile cost (with a
     # single epoch there is no warm-up and compile time is included).
@@ -139,10 +158,14 @@ def main() -> None:
     duration = max(timeit.default_timer() - start, 1e-9)
     pipeline_rows_per_s = rows_consumed / duration
 
+    # Best of two runs: the first warms the page cache, and taking the max
+    # is fairest to the reference on a noisy shared host.
     baseline_files = filenames[:max(1, len(filenames) // 4)]
-    baseline_rows_per_s = _pandas_reference_baseline(
-        baseline_files, num_reducers=max(2, num_reducers // 4),
-        batch_size=batch_size)
+    baseline_rows_per_s = max(
+        _pandas_reference_baseline(baseline_files,
+                                   num_reducers=max(2, num_reducers // 4),
+                                   batch_size=batch_size)
+        for _ in range(2))
     print(f"# pipeline: {pipeline_rows_per_s:,.0f} rows/s | "
           f"pandas reference algo: {baseline_rows_per_s:,.0f} rows/s | "
           f"stall {ds.batch_wait_stats.summary()['total']:.3f}s over "
