@@ -1,0 +1,104 @@
+"""ctypes loader for the native batch image decoder (src/image_decode.cpp).
+
+Built lazily on first use (g++, linked against the system libjpeg/libpng);
+every caller must handle :func:`available` returning False — the PIL
+fallback in workloads/imagenet.py keeps the pipeline working on hosts
+without a compiler or the codec libraries.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import List, Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "image_decode.cpp")
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "src",
+                         "libimage_decode.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_attempted = False
+_load_lock = threading.Lock()
+
+_DEFAULT_THREADS = max(1, min(8, (os.cpu_count() or 1)))
+
+
+def _build() -> bool:
+    cmd = [
+        "g++", "-O2", "-std=c++17", "-shared", "-fPIC", "-pthread", _SRC,
+        "-o", _LIB_PATH, "-ljpeg", "-lpng",
+    ]
+    try:
+        proc = subprocess.run(cmd, capture_output=True, text=True,
+                              timeout=120)
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    return proc.returncode == 0
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_attempted
+    if _load_attempted:
+        return _lib
+    with _load_lock:
+        if _load_attempted:
+            return _lib
+        if os.environ.get("RSDL_TPU_DISABLE_NATIVE"):
+            _load_attempted = True
+            return None
+        try:
+            needs_build = (not os.path.exists(_LIB_PATH)
+                           or os.path.getmtime(_LIB_PATH)
+                           < os.path.getmtime(_SRC))
+            if needs_build and not _build():
+                return None
+            lib = ctypes.CDLL(_LIB_PATH)
+            lib.rsdl_decode_images.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p),
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
+                ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_uint8), ctypes.c_int
+            ]
+            lib.rsdl_decode_images.restype = ctypes.c_int64
+            _lib = lib
+        except (OSError, AttributeError):
+            _lib = None
+        finally:
+            _load_attempted = True
+        return _lib
+
+
+def available() -> bool:
+    """True if the native decoder is built and loaded."""
+    return _load() is not None
+
+
+def decode_batch(payloads: List[bytes], height: int, width: int,
+                 nthreads: Optional[int] = None) -> np.ndarray:
+    """Decode JPEG/PNG payloads to one ``(n, height*width*3)`` uint8 array.
+
+    Raises ValueError naming the first payload that failed to decode or
+    had the wrong dimensions.
+    """
+    lib = _load()
+    assert lib is not None
+    n = len(payloads)
+    out = np.empty((n, height * width * 3), dtype=np.uint8)
+    if n == 0:
+        return out
+    srcs = (ctypes.c_char_p * n)(*payloads)
+    sizes = np.fromiter((len(p) for p in payloads), dtype=np.int64, count=n)
+    rc = lib.rsdl_decode_images(
+        srcs, sizes.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
+        height, width, out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        nthreads or _DEFAULT_THREADS)
+    if rc != 0:
+        raise ValueError(
+            f"image {rc - 1} failed to decode to ({height}, {width}, 3) — "
+            "unsupported format or wrong dimensions; the TPU pipeline "
+            "requires fixed shapes")
+    return out

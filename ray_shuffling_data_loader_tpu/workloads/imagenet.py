@@ -119,25 +119,30 @@ def decode_transform(height: int,
     expected_shape = (height, width, channels)
     flat_len = height * width * channels
 
-    def transform(table: pa.Table) -> pa.Table:
+    def decode_pil(payloads) -> np.ndarray:
         from PIL import Image
-        column = table.column(image_column)
-        num_rows = table.num_rows
-        out = np.empty((num_rows, flat_len), dtype=np.uint8)
-        i = 0
-        for chunk in column.chunks:
-            for payload in chunk:
-                image = Image.open(io.BytesIO(payload.as_py()))
-                if channels == 3:
-                    image = image.convert("RGB")
-                arr = np.asarray(image, dtype=np.uint8)
-                if arr.shape != expected_shape:
-                    raise ValueError(
-                        f"decoded image shape {arr.shape} != expected "
-                        f"{expected_shape}; resize at generation time — "
-                        "the TPU pipeline requires fixed shapes")
-                out[i] = arr.reshape(-1)
-                i += 1
+        out = np.empty((len(payloads), flat_len), dtype=np.uint8)
+        for i, payload in enumerate(payloads):
+            image = Image.open(io.BytesIO(payload))
+            if channels == 3:
+                image = image.convert("RGB")
+            arr = np.asarray(image, dtype=np.uint8)
+            if arr.shape != expected_shape:
+                raise ValueError(
+                    f"decoded image shape {arr.shape} != expected "
+                    f"{expected_shape}; resize at generation time — "
+                    "the TPU pipeline requires fixed shapes")
+            out[i] = arr.reshape(-1)
+        return out
+
+    def transform(table: pa.Table) -> pa.Table:
+        from ray_shuffling_data_loader_tpu.native import image as native_image
+        payloads = table.column(image_column).to_pylist()
+        if channels == 3 and native_image.available():
+            # Threaded libjpeg/libpng batch decode (C++); PIL otherwise.
+            out = native_image.decode_batch(payloads, height, width)
+        else:
+            out = decode_pil(payloads)
         decoded = pa.FixedSizeListArray.from_arrays(
             pa.array(out.reshape(-1)), flat_len)
         index = table.schema.get_field_index(image_column)

@@ -1,0 +1,89 @@
+"""Tests for the native batch image decoder (native/image.py +
+native/src/image_decode.cpp): exact agreement with PIL, error reporting,
+and the decode_transform dispatch."""
+
+import io
+
+import numpy as np
+import pytest
+
+from ray_shuffling_data_loader_tpu.native import image as native_image
+
+
+def _png(arr: np.ndarray) -> bytes:
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="png")
+    return buf.getvalue()
+
+
+def _jpeg(arr: np.ndarray, quality: int = 90) -> bytes:
+    from PIL import Image
+    buf = io.BytesIO()
+    Image.fromarray(arr).save(buf, format="jpeg", quality=quality)
+    return buf.getvalue()
+
+
+needs_native = pytest.mark.skipif(not native_image.available(),
+                                  reason="native decoder unavailable")
+
+
+@needs_native
+def test_png_decode_matches_pil_exactly(rng):
+    images = [
+        rng.integers(0, 256, (16, 12, 3)).astype(np.uint8) for _ in range(9)
+    ]
+    payloads = [_png(a) for a in images]
+    out = native_image.decode_batch(payloads, 16, 12)
+    for i, want in enumerate(images):
+        np.testing.assert_array_equal(out[i].reshape(16, 12, 3), want)
+
+
+@needs_native
+def test_jpeg_decode_matches_pil(rng):
+    from PIL import Image
+    images = [
+        rng.integers(0, 256, (24, 24, 3)).astype(np.uint8) for _ in range(4)
+    ]
+    payloads = [_jpeg(a) for a in images]
+    out = native_image.decode_batch(payloads, 24, 24)
+    for i, payload in enumerate(payloads):
+        want = np.asarray(Image.open(io.BytesIO(payload)).convert("RGB"))
+        got = out[i].reshape(24, 24, 3)
+        # Both use libjpeg(-turbo); allow a 1-LSB IDCT difference.
+        assert np.abs(got.astype(int) - want.astype(int)).max() <= 1
+
+
+@needs_native
+def test_decode_batch_reports_failing_index(rng):
+    good = _png(rng.integers(0, 256, (8, 8, 3)).astype(np.uint8))
+    with pytest.raises(ValueError, match="image 1 "):
+        native_image.decode_batch([good, b"not-an-image", good], 8, 8)
+
+
+@needs_native
+def test_decode_batch_rejects_wrong_dims(rng):
+    wrong = _png(rng.integers(0, 256, (8, 9, 3)).astype(np.uint8))
+    with pytest.raises(ValueError, match="image 0 "):
+        native_image.decode_batch([wrong], 8, 8)
+
+
+@needs_native
+def test_decode_batch_empty():
+    assert native_image.decode_batch([], 8, 8).shape == (0, 192)
+
+
+@needs_native
+def test_decode_transform_native_matches_pil_path(rng, tmp_parquet_dir,
+                                                  monkeypatch):
+    """The reduce transform yields identical tables through either path."""
+    from ray_shuffling_data_loader_tpu.workloads import imagenet
+    import pyarrow.parquet as pq
+
+    filenames, _ = imagenet.generate_imagenet_parquet(
+        12, 1, tmp_parquet_dir, height=10, width=10, num_classes=3, seed=2)
+    table = pq.read_table(filenames[0])
+    native_out = imagenet.decode_transform(10, 10)(table)
+    monkeypatch.setattr(native_image, "available", lambda: False)
+    pil_out = imagenet.decode_transform(10, 10)(table)
+    assert native_out.equals(pil_out)
