@@ -105,30 +105,17 @@ def main() -> None:
     # pipelines read/partition/permute stages against consumption.
     num_reducers = max(4, default_num_reducers(num_trainers=1))
 
-    # Narrowest dtype per column that covers its cardinality
-    # (data_generation DATA_SPEC): cast at the map stage, so every
-    # downstream byte — partition, permute-gather, re-batch, host->HBM
-    # DMA — is 43B/row instead of 76B. Indices widen for free on device.
-    def narrow_dtype(high):
-        if high <= 127:
-            return np.int8
-        if high <= 32767:
-            return np.int16
-        return np.int32
-
-    feature_types = [
-        narrow_dtype(datagen.DATA_SPEC[c][1])
-        for c in datagen.FEATURE_COLUMNS
-    ]
+    # Narrowest dtype per column that covers its cardinality, cast at the
+    # map stage: every downstream byte — partition, permute-gather,
+    # re-batch, host->HBM DMA — is 43B/row instead of 76B. Indices widen
+    # for free on device (workloads/dlrm_criteo.py).
+    from ray_shuffling_data_loader_tpu.workloads.dlrm_criteo import dlrm_spec
 
     ds = JaxShufflingDataset(
         filenames, num_epochs=num_epochs, num_trainers=1,
         batch_size=batch_size, rank=0,
-        feature_columns=list(datagen.FEATURE_COLUMNS),
-        feature_types=feature_types,
-        label_column=datagen.LABEL_COLUMN,
         num_reducers=num_reducers, max_concurrent_epochs=2, seed=0,
-        queue_name="bench-queue", drop_last=True)
+        queue_name="bench-queue", drop_last=True, **dlrm_spec())
 
     # Tiny jitted reduction per batch: forces the batch to land on device;
     # negligible compute (sparse-feature columns arrive as one pytree

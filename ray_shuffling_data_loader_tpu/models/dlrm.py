@@ -123,18 +123,26 @@ def param_specs(config: DLRMConfig, model_axis: str = "model"
 
 
 def apply(config: DLRMConfig, params: Dict[str, Any],
-          dense: Optional[jax.Array], sparse: jax.Array) -> jax.Array:
-    """Forward: sparse (batch, num_sparse) int32 indices,
-    dense (batch, dense_dim) or None. Returns (batch, 1) f32 logits."""
+          dense: Optional[jax.Array], sparse) -> jax.Array:
+    """Forward: sparse is a (batch, num_sparse) int index array OR a list
+    of per-feature (batch,)/(batch, 1) index arrays — the latter is what
+    ``JaxShufflingDataset`` yields with per-column narrow dtypes
+    (workloads/dlrm_criteo.py). dense (batch, dense_dim) or None.
+    Returns (batch, 1) f32 logits."""
     dtype = config.compute_dtype
+    is_columns = isinstance(sparse, (list, tuple))
+    if is_columns and len(sparse) != config.num_sparse:
+        raise ValueError(
+            f"expected {config.num_sparse} sparse columns, got "
+            f"{len(sparse)}")
     # One embedding lookup per feature (ops/embedding.py picks the hardware
     # path per table size). Tables are stacked feature-wise afterwards.
     vectors = []
     for i in range(config.num_sparse):
+        idx = sparse[i].reshape(-1) if is_columns else sparse[:, i]
         vectors.append(
-            embedding.lookup(params["embeddings"][f"table_{i}"],
-                             sparse[:, i], dtype,
-                             mode=config.lookup_mode))
+            embedding.lookup(params["embeddings"][f"table_{i}"], idx,
+                             dtype, mode=config.lookup_mode))
     if config.dense_dim > 0:
         bottom_cfg = _mlp_cfg(config.dense_dim, config.bottom_hidden,
                               config.embed_dim, dtype)
@@ -179,7 +187,7 @@ def validate_sparse_batch(config: DLRMConfig, sparse) -> None:
 
 
 def loss_fn(config: DLRMConfig, params: Dict[str, Any],
-            dense: Optional[jax.Array], sparse: jax.Array,
+            dense: Optional[jax.Array], sparse,
             labels: jax.Array) -> jax.Array:
     """Sigmoid BCE-with-logits, mean over the batch."""
     logits = apply(config, params, dense, sparse)

@@ -1,0 +1,60 @@
+"""DLRM click-log workload: the reference's own data spec, end to end.
+
+BASELINE config 5: "DLRM on Criteo-1TB click logs, distributed shuffle
+across v4-32". The reference generates DLRM-shaped rows (17 embedding
+columns with Criteo-like cardinalities + 2 one-hots + float label,
+reference: data_generation.py:74-95) but never trains on them — its train
+step is a mock sleep (reference: ray_torch_shuffle.py:199-204). This
+module wires that schema through the shuffle into the real DLRM model
+(models/dlrm.py):
+
+- :func:`narrowest_dtype` / :func:`dlrm_feature_types`: per-column
+  narrowest integer dtype covering the cardinality (int8/int16/int32).
+  Applied at the map stage (``cast_at_map``), it shrinks every downstream
+  byte — partition, permute-gather, re-batch, host->HBM DMA — from 76 to
+  43 bytes/row for the reference spec; indices widen for free on device.
+- :func:`dlrm_spec`: ``JaxShufflingDataset`` kwargs for the schema.
+- Multi-host (v4-32 and up): run the same spec with
+  ``parallel.distributed.create_distributed_batch_queue_and_shuffle`` on
+  each host — examples/jax_train_shuffle.py shows the full recipe
+  (``RSDL_HOSTS`` global shuffle + per-host consumer queues).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List
+
+import numpy as np
+
+from ray_shuffling_data_loader_tpu import data_generation as dg
+
+
+def narrowest_dtype(cardinality: int) -> np.dtype:
+    """Smallest signed integer dtype that represents [0, cardinality)."""
+    if cardinality <= 2**7:
+        return np.dtype(np.int8)
+    if cardinality <= 2**15:
+        return np.dtype(np.int16)
+    if cardinality <= 2**31:
+        return np.dtype(np.int32)
+    return np.dtype(np.int64)
+
+
+def dlrm_feature_types(
+        columns: List[str] = None) -> List[np.dtype]:
+    """Narrowest dtype per feature column of the reference DATA_SPEC."""
+    if columns is None:
+        columns = list(dg.FEATURE_COLUMNS)
+    return [narrowest_dtype(dg.DATA_SPEC[c][1]) for c in columns]
+
+
+def dlrm_spec() -> Dict[str, Any]:
+    """``JaxShufflingDataset`` kwargs for the reference's DLRM schema with
+    narrow-dtype transfer. Features arrive as one per-column list (the
+    access pattern DLRM's per-table lookups want)."""
+    return {
+        "feature_columns": list(dg.FEATURE_COLUMNS),
+        "feature_types": dlrm_feature_types(),
+        "label_column": dg.LABEL_COLUMN,
+        "label_type": np.float32,
+    }
