@@ -285,7 +285,10 @@ class ShufflingDataset:
                 raise RuntimeError(
                     "the shuffle driver died; no more batches are coming"
                 ) from ref.error
-            table: pa.Table = ref.result()
+            # In-process queues carry TaskRefs; remote queue clients
+            # (multiqueue_service.py) deliver materialized tables.
+            table: pa.Table = (ref.result() if hasattr(ref, "result")
+                               else ref)
             if to_skip:
                 if table.num_rows <= to_skip:
                     to_skip -= table.num_rows

@@ -1,0 +1,209 @@
+"""Cross-process queue service: trainer processes attach by address.
+
+The reference's queue is a Ray actor precisely so that trainer processes
+spawned elsewhere (Horovod workers with no handle to driver state) can
+rendezvous with the pipeline by name (reference: multiqueue.py:310-332,
+SURVEY.md §1). Our in-process ``MultiQueue`` covers the SPMD
+one-process-per-host topology; this module restores the reference's
+*separate-trainer-process* topology:
+
+- :func:`serve_queue` exports an existing ``MultiQueue`` over TCP. For
+  each GET the server resolves the queued ref to its pyarrow Table and
+  streams it as Arrow IPC — consumers never see executor internals, and
+  data crosses the process boundary zero-copy on the Arrow buffers.
+- :class:`RemoteQueue` is the consumer side: ``get(queue_idx)`` returns a
+  materialized ``pa.Table`` (or ``None`` for the epoch-end sentinel), so
+  it plugs straight into ``ShufflingDataset(batch_queue=...)`` /
+  ``JaxShufflingDataset`` — same consumer code as in-process, matching
+  the reference's connect-by-name contract (retry with doubling backoff).
+
+Wire format, little-endian: requests are ``(u32 queue_idx)``; responses
+are ``(u8 kind, u64 length, payload)`` with kind 0=table IPC stream,
+1=epoch-end sentinel, 2=shuffle-failure (payload = error text).
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+import time
+from typing import Optional, Tuple
+
+import pyarrow as pa
+
+from ray_shuffling_data_loader_tpu import multiqueue as mq
+from ray_shuffling_data_loader_tpu.dataset import ShuffleFailure
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+_REQUEST = struct.Struct("<I")
+_RESPONSE = struct.Struct("<BQ")
+
+KIND_TABLE = 0
+KIND_SENTINEL = 1
+KIND_FAILURE = 2
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    remaining = n
+    while remaining:
+        chunk = sock.recv(min(remaining, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed connection mid-message")
+        chunks.append(chunk)
+        remaining -= len(chunk)
+    return b"".join(chunks) if len(chunks) != 1 else chunks[0]
+
+
+def _serialize(table: pa.Table) -> pa.Buffer:
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, table.schema) as writer:
+        writer.write_table(table)
+    return sink.getvalue()
+
+
+class QueueServer:
+    """Exports a ``MultiQueue`` over TCP. One thread per consumer
+    connection; a GET blocks server-side until the queue yields (and the
+    ref materializes), so consumer backpressure is preserved."""
+
+    def __init__(self, queue: mq.MultiQueue, address: Tuple[str, int]):
+        self._queue = queue
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(address)
+        listener.listen(16)
+        self._listener = listener
+        self._closed = threading.Event()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True, name="rsdl-qserve-accept")
+        self._accept_thread.start()
+
+    @property
+    def address(self) -> Tuple[str, int]:
+        return self._listener.getsockname()
+
+    def _accept_loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                conn, peer = self._listener.accept()
+            except OSError:
+                return
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True, name="rsdl-qserve-conn").start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            while not self._closed.is_set():
+                raw = conn.recv(_REQUEST.size)
+                if not raw:
+                    return  # consumer done
+                if len(raw) < _REQUEST.size:
+                    raw += _recv_exact(conn, _REQUEST.size - len(raw))
+                (queue_idx,) = _REQUEST.unpack(raw)
+                item = self._queue.get(queue_idx, block=True)
+                if item is None:
+                    conn.sendall(_RESPONSE.pack(KIND_SENTINEL, 0))
+                elif isinstance(item, ShuffleFailure):
+                    text = repr(item.error).encode()
+                    conn.sendall(_RESPONSE.pack(KIND_FAILURE, len(text)))
+                    conn.sendall(text)
+                else:
+                    table = item.result() if hasattr(item, "result") else item
+                    payload = _serialize(table)
+                    conn.sendall(_RESPONSE.pack(KIND_TABLE, payload.size))
+                    conn.sendall(payload)
+        except (ConnectionError, OSError) as e:
+            if not self._closed.is_set():
+                logger.warning("queue server connection dropped: %s", e)
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        self._closed.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "QueueServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serve_queue(queue: mq.MultiQueue,
+                address: Tuple[str, int] = ("127.0.0.1", 0)) -> QueueServer:
+    """Start serving ``queue`` on ``address`` (port 0 = ephemeral)."""
+    return QueueServer(queue, address)
+
+
+class RemoteQueue:
+    """Consumer-side handle to a served queue.
+
+    ``get`` returns a materialized ``pa.Table``, ``None`` (epoch end), or
+    a :class:`ShuffleFailure` — the exact item vocabulary
+    ``ShufflingDataset.__iter__`` consumes, so
+    ``ShufflingDataset(batch_queue=RemoteQueue(addr), shuffle_result=None)``
+    is a drop-in remote trainer. Connects with the reference's
+    retry-with-doubling-backoff schedule (reference: multiqueue.py:310-332).
+    """
+
+    def __init__(self, address: Tuple[str, int],
+                 retries: int = mq.CONNECT_RETRIES,
+                 initial_backoff_s: float = mq.CONNECT_INITIAL_BACKOFF_S):
+        last_err: Optional[Exception] = None
+        backoff = initial_backoff_s
+        for attempt in range(retries + 1):
+            try:
+                self._sock = socket.create_connection(address, timeout=30)
+                self._sock.settimeout(None)
+                self._sock.setsockopt(socket.IPPROTO_TCP,
+                                      socket.TCP_NODELAY, 1)
+                last_err = None
+                break
+            except OSError as e:
+                last_err = e
+                if attempt < retries:
+                    time.sleep(backoff)
+                    backoff *= 2
+        if last_err is not None:
+            raise ConnectionError(
+                f"could not reach queue server at {address} after "
+                f"{retries + 1} attempts: {last_err}")
+        self._lock = threading.Lock()
+
+    def get(self, queue_index: int, block: bool = True):
+        if not block:
+            raise ValueError("RemoteQueue only supports blocking gets")
+        with self._lock:
+            self._sock.sendall(_REQUEST.pack(queue_index))
+            header = _recv_exact(self._sock, _RESPONSE.size)
+            kind, length = _RESPONSE.unpack(header)
+            payload = _recv_exact(self._sock, length) if length else b""
+        if kind == KIND_SENTINEL:
+            return None
+        if kind == KIND_FAILURE:
+            return ShuffleFailure(RuntimeError(payload.decode()))
+        with pa.ipc.open_stream(pa.BufferReader(payload)) as reader:
+            return reader.read_all()
+
+    def close(self) -> None:
+        try:
+            self._sock.close()
+        except OSError:
+            pass
+
+    def __enter__(self) -> "RemoteQueue":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
