@@ -304,6 +304,14 @@ def flash_attention(q: jax.Array,
     return out
 
 
+def flash_forward(q, k, v, bias=None, block_q: int = 128,
+                  block_k: int = 128, interpret: bool = False):
+    """Forward kernels only: returns ``(out, lse)`` with lse
+    (B, H, Sq, 1) float32 — the partial-softmax residual ring attention
+    needs to merge per-hop results (ops/ring_attention.py)."""
+    return _flash_forward(q, k, v, bias, block_q, block_k, interpret)
+
+
 def _flash_fwd(q, k, v, bias, block_q, block_k, interpret):
     out, lse = _flash_forward(q, k, v, bias, block_q, block_k, interpret)
     return out, (q, k, v, bias, out, lse)
@@ -311,6 +319,17 @@ def _flash_fwd(q, k, v, bias, block_q, block_k, interpret):
 
 def _flash_bwd(block_q, block_k, interpret, residuals, do):
     q, k, v, bias, out, lse = residuals
+    return flash_backward(q, k, v, bias, out, lse, do, block_q, block_k,
+                          interpret)
+
+
+def flash_backward(q, k, v, bias, out, lse, do, block_q: int = 128,
+                   block_k: int = 128, interpret: bool = False):
+    """Backward kernels: ``(dq, dk, dv, dbias)`` from the standard flash
+    residuals. ``lse`` may be global (covering MORE keys than ``k``) — the
+    ring backward exploits this: with the global logsumexp, the recomputed
+    per-hop weights ``exp(s - lse)`` are the global softmax restricted to
+    this hop's keys, so per-hop grads sum to the exact global gradient."""
     b, h, sq, d = q.shape
     sk = k.shape[2]
     bq, bk, sq_pad, sk_pad = _plan(sq, sk, block_q, block_k, interpret)

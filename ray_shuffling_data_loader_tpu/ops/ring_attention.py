@@ -193,6 +193,111 @@ def _ring_attention_bwd_impl(axis_name: str, causal: bool, q, k, v, kv_bias,
             dbias_out)
 
 
+def _ring_flash_fwd_impl(axis_name: str, block_q: int, block_k: int,
+                         interpret: bool, q, k, v, kv_bias):
+    """Forward ring pass where each hop's local attention runs the Pallas
+    flash kernels (ops/flash_attention.py) instead of einsum — no per-hop
+    O(Sq·Sk) score tensor even locally; per-hop partials merge by
+    logsumexp. Non-causal only (the flash bias is key-side, which cannot
+    express a q×k causal mask)."""
+    from ray_shuffling_data_loader_tpu.ops import flash_attention as fa
+
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    has_bias = kv_bias is not None
+    o0 = jnp.zeros(q.shape, jnp.float32)
+    lse0 = jnp.full(q.shape[:3], _ACC_MIN, jnp.float32)
+
+    def step(i, carry):
+        k_c, v_c, bias_c, o, lse = carry
+        out_h, lse_h = fa.flash_forward(q, k_c, v_c, bias_c,
+                                        block_q=block_q, block_k=block_k,
+                                        interpret=interpret)
+        lse_h = lse_h[..., 0]
+        lse_new = jnp.logaddexp(lse, lse_h)
+        # out_h is this hop's normalized partial; exp(lse_h - lse_new)
+        # rescales it to the global softmax denominator.
+        o = (o * jnp.exp(lse - lse_new)[..., None]
+             + out_h.astype(jnp.float32)
+             * jnp.exp(lse_h - lse_new)[..., None])
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        if has_bias:
+            bias_c = jax.lax.ppermute(bias_c, axis_name, perm)
+        return k_c, v_c, bias_c, o, lse_new
+
+    bias0 = kv_bias.astype(jnp.float32) if has_bias else None
+    _, _, _, o, lse = jax.lax.fori_loop(0, n, step, (k, v, bias0, o0, lse0))
+    return o.astype(q.dtype), lse
+
+
+def _ring_flash_bwd_impl(axis_name: str, block_q: int, block_k: int,
+                         interpret: bool, q, k, v, kv_bias, out, lse, do):
+    """Backward ring pass through the flash backward kernels. The GLOBAL
+    lse makes each hop's recomputed weights the global softmax restricted
+    to that hop's keys, so per-hop kernel grads sum exactly; dk/dv
+    accumulators ride the ring home with their chunks."""
+    from ray_shuffling_data_loader_tpu.ops import flash_attention as fa
+
+    n = jax.lax.psum(1, axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    has_bias = kv_bias is not None
+
+    def step(i, carry):
+        k_c, v_c, bias_c, dk_c, dv_c, dbias_c, dq = carry
+        dq_h, dk_h, dv_h, dbias_h = fa.flash_backward(
+            q, k_c, v_c, bias_c, out, lse, do, block_q=block_q,
+            block_k=block_k, interpret=interpret)
+        dq = dq + dq_h.astype(jnp.float32)
+        dk_c = dk_c + dk_h.astype(jnp.float32)
+        dv_c = dv_c + dv_h.astype(jnp.float32)
+        if has_bias:
+            dbias_c = dbias_c + dbias_h.astype(jnp.float32)
+        k_c = jax.lax.ppermute(k_c, axis_name, perm)
+        v_c = jax.lax.ppermute(v_c, axis_name, perm)
+        dk_c = jax.lax.ppermute(dk_c, axis_name, perm)
+        dv_c = jax.lax.ppermute(dv_c, axis_name, perm)
+        if has_bias:
+            bias_c = jax.lax.ppermute(bias_c, axis_name, perm)
+            dbias_c = jax.lax.ppermute(dbias_c, axis_name, perm)
+        return k_c, v_c, bias_c, dk_c, dv_c, dbias_c, dq
+
+    bias0 = kv_bias.astype(jnp.float32) if has_bias else None
+    dbias0 = (jnp.zeros((q.shape[0], 1, 1, k.shape[2]), jnp.float32)
+              if has_bias else None)
+    carry0 = (k, v, bias0, jnp.zeros(k.shape, jnp.float32),
+              jnp.zeros(v.shape, jnp.float32), dbias0,
+              jnp.zeros(q.shape, jnp.float32))
+    _, _, _, dk, dv, dbias, dq = jax.lax.fori_loop(0, n, step, carry0)
+    dbias_out = dbias.astype(kv_bias.dtype) if has_bias else None
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            dbias_out)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
+def _ring_flash_prim(axis_name: str, block_q: int, block_k: int,
+                     interpret: bool, q, k, v, kv_bias):
+    return _ring_flash_fwd_impl(axis_name, block_q, block_k, interpret,
+                                q, k, v, kv_bias)[0]
+
+
+def _ring_flash_prim_fwd(axis_name, block_q, block_k, interpret, q, k, v,
+                         kv_bias):
+    out, lse = _ring_flash_fwd_impl(axis_name, block_q, block_k, interpret,
+                                    q, k, v, kv_bias)
+    return out, (q, k, v, kv_bias, out, lse)
+
+
+def _ring_flash_prim_bwd(axis_name, block_q, block_k, interpret, residuals,
+                         do):
+    q, k, v, kv_bias, out, lse = residuals
+    return _ring_flash_bwd_impl(axis_name, block_q, block_k, interpret,
+                                q, k, v, kv_bias, out, lse, do)
+
+
+_ring_flash_prim.defvjp(_ring_flash_prim_fwd, _ring_flash_prim_bwd)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
 def _ring_attention_prim(axis_name: str, causal: bool, q, k, v, kv_bias):
     return _ring_attention_fwd_impl(axis_name, causal, q, k, v, kv_bias)[0]
@@ -212,7 +317,9 @@ def _ring_prim_bwd(axis_name, causal, residuals, do):
 _ring_attention_prim.defvjp(_ring_prim_fwd, _ring_prim_bwd)
 
 
-def _ring_attention_shard(q, k, v, kv_bias, axis_name: str, causal: bool):
+def _ring_attention_shard(q, k, v, kv_bias, axis_name: str, causal: bool,
+                          use_flash: bool = False, block_q: int = 128,
+                          block_k: int = 128, interpret: bool = False):
     """Per-shard ring attention body; must run under shard_map/pmap.
 
     q/k/v: (B, H, S_local, D) — this device's sequence chunk. kv_bias:
@@ -222,7 +329,12 @@ def _ring_attention_shard(q, k, v, kv_bias, axis_name: str, causal: bool):
 
     Differentiable via a custom VJP that reruns the ring (recompute per
     hop) instead of letting AD save every per-step O(S²/n²) intermediate.
+    With ``use_flash`` each hop runs the Pallas flash kernels, removing
+    even the per-hop local score tensor (non-causal only).
     """
+    if use_flash:
+        return _ring_flash_prim(axis_name, block_q, block_k, interpret,
+                                q, k, v, kv_bias)
     return _ring_attention_prim(axis_name, causal, q, k, v, kv_bias)
 
 
@@ -250,7 +362,8 @@ def ring_self_attention(q: jax.Array,
                         seq_axis: str,
                         bias: Optional[jax.Array] = None,
                         batch_axis: Optional[str] = None,
-                        causal: bool = False) -> jax.Array:
+                        causal: bool = False,
+                        use_flash: Optional[bool] = None) -> jax.Array:
     """Exact attention over a sequence sharded on ``mesh[seq_axis]``.
 
     Args:
@@ -259,11 +372,25 @@ def ring_self_attention(q: jax.Array,
         bias: optional additive key-side bias (B, 1, 1, S) (e.g. padding
             mask as 0 / NEG_INF), sharded like the K sequence axis.
         causal: apply a causal mask using global positions.
+        use_flash: run each hop's local attention through the Pallas flash
+            kernels (no per-hop score tensor at all). ``None`` = auto: on
+            for non-causal on a real TPU backend, off elsewhere. Explicit
+            ``True`` off-TPU uses the (slow) Pallas interpreter — tests
+            only. Causal + flash is rejected: the flash bias is key-side
+            and cannot express a q×k causal mask.
 
     Returns (B, H, S, D), sharded like ``q``.
     """
+    interpret = jax.default_backend() != "tpu"
+    if use_flash is None:
+        use_flash = not causal and not interpret
+    if use_flash and causal:
+        raise ValueError(
+            "use_flash=True does not support causal=True (key-side bias "
+            "cannot express the causal mask); use the einsum ring path")
     shard_fn = functools.partial(_ring_attention_shard, axis_name=seq_axis,
-                                 causal=causal)
+                                 causal=causal, use_flash=use_flash,
+                                 interpret=interpret)
     return _dispatch_sharded(shard_fn, q, k, v, bias, mesh, seq_axis,
                              batch_axis)
 
@@ -334,11 +461,14 @@ def make_attention_fn(mesh: Mesh,
                       seq_axis: str,
                       strategy: str = "ring",
                       batch_axis: Optional[str] = None,
-                      causal: bool = False):
+                      causal: bool = False,
+                      use_flash: Optional[bool] = None):
     """An ``attention_fn(q, k, v, bias) -> out`` closure for models/bert.py's
-    pluggable attention, bound to a mesh and strategy ("ring" | "ulysses")."""
+    pluggable attention, bound to a mesh and strategy ("ring" | "ulysses").
+    ``use_flash`` (ring only) routes each hop through the Pallas flash
+    kernels — see :func:`ring_self_attention`."""
     if strategy == "ring":
-        impl = ring_self_attention
+        impl = functools.partial(ring_self_attention, use_flash=use_flash)
     elif strategy == "ulysses":
         impl = ulysses_attention
     else:
