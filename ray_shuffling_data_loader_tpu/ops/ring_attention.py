@@ -409,7 +409,8 @@ def _full_attention(q, k, v, bias):
     return out.astype(q.dtype)
 
 
-def _ulysses_shard(q, k, v, kv_bias, axis_name: str, causal: bool):
+def _ulysses_shard(q, k, v, kv_bias, axis_name: str, causal: bool,
+                   use_flash: bool = False, interpret: bool = False):
     """Per-shard Ulysses body: seq-sharded -> head-sharded -> back."""
     # (B, H, S/n, D) -> (B, H/n, S, D): scatter heads, gather sequence.
     q = jax.lax.all_to_all(q, axis_name, split_axis=1, concat_axis=2,
@@ -423,11 +424,15 @@ def _ulysses_shard(q, k, v, kv_bias, axis_name: str, causal: bool):
         # Key-side bias has no head dim to scatter — gather the full-length
         # bias on every device instead.
         bias = jax.lax.all_gather(kv_bias, axis_name, axis=3, tiled=True)
-    if causal:
-        pos = jnp.arange(q.shape[2])
-        cb = causal_bias(pos, pos)
-        bias = cb if bias is None else bias + cb
-    out = _full_attention(q, k, v, bias)
+    if use_flash and not causal:
+        from ray_shuffling_data_loader_tpu.ops import flash_attention as fa
+        out = fa.flash_attention(q, k, v, bias, interpret=interpret)
+    else:
+        if causal:
+            pos = jnp.arange(q.shape[2])
+            cb = causal_bias(pos, pos)
+            bias = cb if bias is None else bias + cb
+        out = _full_attention(q, k, v, bias)
     # (B, H/n, S, D) -> (B, H, S/n, D): back to sequence-sharded.
     return jax.lax.all_to_all(out, axis_name, split_axis=2, concat_axis=1,
                               tiled=True)
@@ -440,19 +445,30 @@ def ulysses_attention(q: jax.Array,
                       seq_axis: str,
                       bias: Optional[jax.Array] = None,
                       batch_axis: Optional[str] = None,
-                      causal: bool = False) -> jax.Array:
+                      causal: bool = False,
+                      use_flash: Optional[bool] = None) -> jax.Array:
     """DeepSpeed-Ulysses-style all-to-all sequence parallelism.
 
     Same contract as :func:`ring_self_attention`; additionally requires the
-    head count be divisible by the ``seq_axis`` size.
+    head count be divisible by the ``seq_axis`` size. ``use_flash`` runs
+    the per-shard full-sequence attention through the Pallas flash kernels
+    (non-causal only; ``None`` = auto-on for non-causal on real TPUs).
     """
     n = mesh.shape[seq_axis]
     if q.shape[1] % n != 0:
         raise ValueError(
             f"ulysses_attention needs num_heads ({q.shape[1]}) divisible by "
             f"mesh axis '{seq_axis}' size ({n})")
+    interpret = jax.default_backend() != "tpu"
+    if use_flash is None:
+        use_flash = not causal and not interpret
+    if use_flash and causal:
+        raise ValueError(
+            "use_flash=True does not support causal=True (key-side bias "
+            "cannot express the causal mask)")
     shard_fn = functools.partial(_ulysses_shard, axis_name=seq_axis,
-                                 causal=causal)
+                                 causal=causal, use_flash=use_flash,
+                                 interpret=interpret)
     return _dispatch_sharded(shard_fn, q, k, v, bias, mesh, seq_axis,
                              batch_axis)
 
@@ -470,7 +486,7 @@ def make_attention_fn(mesh: Mesh,
     if strategy == "ring":
         impl = functools.partial(ring_self_attention, use_flash=use_flash)
     elif strategy == "ulysses":
-        impl = ulysses_attention
+        impl = functools.partial(ulysses_attention, use_flash=use_flash)
     else:
         raise ValueError(f"unknown strategy {strategy!r}")
 

@@ -129,3 +129,66 @@ def bert_mlm_spec(seq_len: int) -> Dict[str, Any]:
         "label_column": LABEL_COLUMN,
         "label_type": np.int32,
     }
+
+
+if __name__ == "__main__":
+    # Smoke driver (reference pattern: dataset.py:233-276): tokenized
+    # shards -> shuffle -> on-device dynamic masking -> BERT train loop.
+    import argparse
+    import tempfile
+    import timeit
+
+    parser = argparse.ArgumentParser(description="BERT-MLM workload smoke")
+    parser.add_argument("--num-sequences", type=int, default=4096)
+    parser.add_argument("--num-files", type=int, default=4)
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--seq-len", type=int, default=128)
+    parser.add_argument("--vocab-size", type=int, default=8192)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
+    from ray_shuffling_data_loader_tpu.models import bert
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        filenames, _ = generate_tokenized_parquet(
+            args.num_sequences, args.num_files, tmpdir,
+            seq_len=args.seq_len, vocab_size=args.vocab_size)
+        ds = JaxShufflingDataset(
+            filenames, num_epochs=args.num_epochs, num_trainers=1,
+            batch_size=args.batch_size, rank=0, drop_last=True,
+            **bert_mlm_spec(args.seq_len))
+        cfg = bert.BertConfig(vocab_size=args.vocab_size, hidden_dim=128,
+                              num_layers=2, num_heads=4, ffn_dim=256,
+                              max_seq_len=args.seq_len)
+        params = bert.init(cfg, jax.random.key(0))
+        opt = optax.adam(1e-4)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, tokens, key):
+            inputs, targets = mlm_mask(tokens, key, args.vocab_size)
+            loss, grads = jax.value_and_grad(
+                lambda p: bert.loss_fn(cfg, p, inputs, targets))(params)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        start = timeit.default_timer()
+        rows = steps = 0
+        for epoch in range(args.num_epochs):
+            ds.set_epoch(epoch)
+            for (tokens,), _ in ds:
+                params, opt_state, loss = step(params, opt_state, tokens,
+                                               jax.random.key(steps))
+                rows += tokens.shape[0]
+                steps += 1
+        jax.block_until_ready(loss)
+        duration = timeit.default_timer() - start
+        print(f"{rows} sequences / {steps} steps in {duration:.2f}s "
+              f"({rows / duration:,.0f} seq/s), final loss "
+              f"{float(loss):.4f}, stall "
+              f"{ds.batch_wait_stats.summary()['total']:.2f}s")

@@ -163,3 +163,65 @@ def imagenet_spec(height: int,
         "label_type": np.int32,
         "reduce_transform": decode_transform(height, width, channels),
     }
+
+
+if __name__ == "__main__":
+    # Smoke driver (reference pattern: dataset.py:233-276): generate
+    # encoded shards, stream decoded batches through the shuffle into a
+    # small ResNet train loop, report rows/s and stall time.
+    import argparse
+    import tempfile
+    import timeit
+
+    parser = argparse.ArgumentParser(description="ImageNet workload smoke")
+    parser.add_argument("--num-images", type=int, default=2048)
+    parser.add_argument("--num-files", type=int, default=4)
+    parser.add_argument("--num-epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=256)
+    parser.add_argument("--height", type=int, default=32)
+    parser.add_argument("--width", type=int, default=32)
+    parser.add_argument("--num-classes", type=int, default=10)
+    args = parser.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
+    from ray_shuffling_data_loader_tpu.models import resnet
+
+    with tempfile.TemporaryDirectory() as tmpdir:
+        filenames, _ = generate_imagenet_parquet(
+            args.num_images, args.num_files, tmpdir, height=args.height,
+            width=args.width, num_classes=args.num_classes)
+        ds = JaxShufflingDataset(
+            filenames, num_epochs=args.num_epochs, num_trainers=1,
+            batch_size=args.batch_size, rank=0, drop_last=False,
+            **imagenet_spec(args.height, args.width))
+        cfg = resnet.resnet18_cifar(num_classes=args.num_classes)
+        params = resnet.init(cfg, jax.random.key(0))
+        opt = optax.sgd(1e-2)
+        opt_state = opt.init(params)
+
+        @jax.jit
+        def step(params, opt_state, images, labels):
+            loss, grads = jax.value_and_grad(lambda p: resnet.loss_fn(
+                cfg, p, images.astype(jnp.float32) / 255.0,
+                labels))(params)
+            updates, opt_state = opt.update(grads, opt_state)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        start = timeit.default_timer()
+        rows = 0
+        for epoch in range(args.num_epochs):
+            ds.set_epoch(epoch)
+            for (images,), labels in ds:
+                params, opt_state, loss = step(params, opt_state, images,
+                                               labels)
+                rows += images.shape[0]
+        jax.block_until_ready(loss)
+        duration = timeit.default_timer() - start
+        print(f"{rows} images in {duration:.2f}s "
+              f"({rows / duration:,.0f} img/s), final loss "
+              f"{float(loss):.4f}, stall "
+              f"{ds.batch_wait_stats.summary()['total']:.2f}s")

@@ -345,3 +345,58 @@ def test_distributed_resume_start_epoch(small_dataset):
         assert list(results[host]) == [1]
         assert results[host][1] == full[host][1], (
             "resumed epoch 1 diverged from the original epoch 1")
+
+
+def test_distributed_shuffle_applies_reduce_transform(tmp_path):
+    """reduce_transform runs inside distributed reduce tasks too, exactly
+    once per row per epoch across all hosts."""
+    import threading
+
+    import pyarrow as pa
+
+    from ray_shuffling_data_loader_tpu import data_generation as dg
+    from ray_shuffling_data_loader_tpu.parallel import distributed as dist
+    from ray_shuffling_data_loader_tpu.parallel import transport as tr
+
+    filenames, _ = dg.generate_data_local(120, 4, 1, 0.0,
+                                          str(tmp_path / "pq"))
+    seen = []
+    lock = threading.Lock()
+
+    def tag_and_record(table: pa.Table) -> pa.Table:
+        with lock:
+            seen.extend(table.column(dg.KEY_COLUMN).to_pylist())
+        return table.append_column(
+            "tagged", pa.array([True] * table.num_rows))
+
+    world = 2
+    transports = tr.create_local_transports(world)
+    collected = {h: [] for h in range(world)}
+
+    def run_host(host):
+        def consumer(rank, epoch, refs):
+            if refs is not None:
+                collected[host].extend(refs)
+
+        dist.shuffle_distributed(
+            filenames, consumer, num_epochs=1, num_reducers=4,
+            transport=transports[host], max_concurrent_epochs=1, seed=5,
+            reduce_transform=tag_and_record)
+
+    threads = [threading.Thread(target=run_host, args=(h,))
+               for h in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    for t in transports:
+        t.close()
+    keys = []
+    for host_refs in collected.values():
+        for ref in host_refs:
+            table = ref.result()
+            assert "tagged" in table.column_names
+            keys.extend(table.column(dg.KEY_COLUMN).to_pylist())
+    assert sorted(keys) == list(range(120))
+    assert sorted(seen) == list(range(120))

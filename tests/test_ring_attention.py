@@ -294,3 +294,40 @@ def test_ring_flash_bert_train_step(rng):
     params = optax.apply_updates(params, updates)
     loss2 = loss_fn(params)
     assert np.isfinite(float(loss2))
+
+
+def test_ulysses_flash_matches_full_attention(rng):
+    q, k, v = _qkv(rng)
+    bias = _padding_bias(rng)
+    mesh = _seq_mesh()
+    got = ra.ulysses_attention(q, k, v, mesh, "seq", bias=bias,
+                               use_flash=True)
+    want = ra._full_attention(q, k, v, bias)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_ulysses_flash_gradients_match(rng):
+    q, k, v = _qkv(rng)
+    mesh = _seq_mesh()
+
+    def flash_loss(q, k, v):
+        return jnp.sum(ra.ulysses_attention(q, k, v, mesh, "seq",
+                                            use_flash=True) ** 2)
+
+    def full_loss(q, k, v):
+        return jnp.sum(ra._full_attention(q, k, v, None) ** 2)
+
+    g_flash = jax.grad(flash_loss, argnums=(0, 1, 2))(q, k, v)
+    g_full = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for gr, gf in zip(g_flash, g_full):
+        np.testing.assert_allclose(np.asarray(gr), np.asarray(gf),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_ulysses_flash_rejects_causal(rng):
+    q, k, v = _qkv(rng)
+    mesh = _seq_mesh()
+    with pytest.raises(ValueError, match="causal"):
+        ra.ulysses_attention(q, k, v, mesh, "seq", causal=True,
+                             use_flash=True)
