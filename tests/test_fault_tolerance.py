@@ -217,3 +217,29 @@ def test_transport_sender_revives_dead_src_within_grace():
     finally:
         t0.close()
         t1.close()
+
+
+def test_distributed_shuffle_fails_loudly_on_dead_peer(tmp_parquet_dir):
+    """A host whose peer never produces its chunks gets a TransportError /
+    TransportTimeout out of shuffle_distributed — not a hang (SURVEY §5:
+    the reference relies on Ray to detect dead workers)."""
+    from ray_shuffling_data_loader_tpu.parallel import distributed as dist
+
+    filenames, _ = dg.generate_data_local(80, 4, 1, 0.0, tmp_parquet_dir)
+    world = 2
+    transports = tr.create_local_transports(world, recv_timeout_s=3.0)
+    for t in transports:
+        t._reconnect_grace_s = 1.0
+
+    def consumer(rank, epoch, refs):
+        pass
+
+    # Host 1 never runs; host 0's reducers wait on its chunks.
+    transports[1].close()
+    start = time.monotonic()
+    with pytest.raises(tr.TransportError):
+        dist.shuffle_distributed(
+            filenames, consumer, num_epochs=1, num_reducers=4,
+            transport=transports[0], max_concurrent_epochs=1, seed=0)
+    assert time.monotonic() - start < 60
+    transports[0].close()
