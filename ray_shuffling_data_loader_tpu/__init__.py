@@ -12,11 +12,15 @@ Public exports mirror the reference's (reference: __init__.py:1-11).
 
 __version__ = "0.1.0"
 
+from ray_shuffling_data_loader_tpu.checkpoint import (  # noqa: E402,F401
+    LoaderCheckpoint, TrainStateCheckpointer, resume_iterator)
 from ray_shuffling_data_loader_tpu.dataset import (  # noqa: E402,F401
     ShufflingDataset, create_batch_queue_and_shuffle)
 from ray_shuffling_data_loader_tpu.jax_dataset import (  # noqa: E402,F401
     JaxShufflingDataset)
 from ray_shuffling_data_loader_tpu.multiqueue import MultiQueue  # noqa: E402,F401
+from ray_shuffling_data_loader_tpu.multiqueue_service import (  # noqa: E402,F401
+    RemoteQueue, serve_queue)
 from ray_shuffling_data_loader_tpu.shuffle import (  # noqa: E402,F401
     shuffle, shuffle_with_stats, shuffle_no_stats)
 
@@ -27,10 +31,15 @@ __all__ = [
     "ShufflingDataset",
     "JaxShufflingDataset",
     "MultiQueue",
+    "RemoteQueue",
+    "serve_queue",
     "shuffle",
     "shuffle_with_stats",
     "shuffle_no_stats",
     "create_batch_queue_and_shuffle",
+    "LoaderCheckpoint",
+    "TrainStateCheckpointer",
+    "resume_iterator",
     "__version__",
 ]
 
