@@ -29,8 +29,7 @@ MODES = ["take", "one_hot", "pallas"]
 
 
 def _time(fn, *args, iters=20):
-    fn(*args)[0].block_until_ready() if isinstance(fn(*args), tuple) else \
-        jax.block_until_ready(fn(*args))  # compile + warm
+    jax.block_until_ready(fn(*args))  # compile + warm
     start = timeit.default_timer()
     for _ in range(iters):
         out = fn(*args)

@@ -56,18 +56,29 @@ class ShuffleFailure:
 
 def make_failure_broadcaster(batch_queue: mq.MultiQueue,
                              num_queues: int):
-    """``on_failure`` hook for ``run_shuffle_in_background``: best-effort
-    non-blocking put of a :class:`ShuffleFailure` into every queue (bounded
-    queues that are full are skipped — their consumers will still drain to
-    the marker's slot eventually or hit the driver error at join)."""
+    """``on_failure`` hook for ``run_shuffle_in_background``: put a
+    :class:`ShuffleFailure` into every queue. A full bounded queue has
+    items EVICTED to make room — the pipeline is dead, so pending batches
+    are worthless, and without the marker a consumer that drains the
+    buffered batches would block forever on the next ``get``."""
 
     def broadcast(error: BaseException) -> None:
         marker = ShuffleFailure(error)
         for queue_idx in range(num_queues):
-            try:
-                batch_queue.put_nowait(queue_idx, marker)
-            except (mq.Full, RuntimeError):
-                pass
+            # Evict-and-retry loop, bounded in case a live consumer races
+            # the eviction: each iteration frees one slot, so maxsize
+            # iterations always suffice absent consumers.
+            for _ in range(10_000):
+                try:
+                    batch_queue.put_nowait(queue_idx, marker)
+                    break
+                except mq.Full:
+                    try:
+                        batch_queue.get_nowait(queue_idx)
+                    except mq.Empty:
+                        continue  # consumer drained it; retry the put
+                except RuntimeError:
+                    break  # queue shut down — nobody left to notify
 
     return broadcast
 

@@ -114,9 +114,13 @@ class Executor:
                  task_retries: int = 0):
         """``task_retries``: re-run a task that raises up to N extra times
         before surfacing the failure — the stand-in for Ray's implicit task
-        retry the reference leans on (SURVEY.md §5). Safe for shuffle tasks
-        because every random draw is keyed by (seed, epoch, task), so a
-        retried task reproduces its output exactly."""
+        retry the reference leans on (SURVEY.md §5). Safe for local shuffle
+        tasks (every random draw is keyed by (seed, epoch, task), so a
+        retried task reproduces its output exactly) and for distributed MAP
+        tasks (re-sent chunks are deduplicated by the receiver). NOT safe
+        for tasks that consume one-shot inputs — distributed REDUCE tasks
+        consume transport messages exactly once, so they are submitted via
+        :meth:`submit_once`."""
         if num_workers is None:
             num_workers = os.cpu_count() or 4
         if task_retries < 0:
@@ -137,6 +141,15 @@ class Executor:
         if self._task_retries:
             return TaskRef(self._pool.submit(self._run_with_retries, fn,
                                              args, kwargs))
+        return TaskRef(self._pool.submit(fn, *args, **kwargs))
+
+    def submit_once(self, fn: Callable, *args, **kwargs) -> TaskRef:
+        """Submit WITHOUT the executor's retry policy — for tasks whose
+        inputs are consumed on first use (e.g. one-shot transport
+        messages), where a retry could only block and then fail with a
+        misleading timeout."""
+        if self._shutdown:
+            raise RuntimeError("executor is shut down")
         return TaskRef(self._pool.submit(fn, *args, **kwargs))
 
     def _run_with_retries(self, fn: Callable, args, kwargs) -> Any:

@@ -170,19 +170,28 @@ def validate_sparse_batch(config: DLRMConfig, sparse) -> None:
     not NaN a step), which also means a *systematically* broken pipeline
     would train silently on edge rows — run this on the host batch (e.g.
     every N steps or in a debug mode) to surface corruption loudly.
+    Accepts both batch layouts ``apply`` does: one (batch, num_sparse)
+    array or a list of per-feature (batch,)/(batch, 1) columns.
     """
     import numpy as np
-    arr = np.asarray(sparse)
-    if arr.shape[-1] != config.num_sparse:
-        raise ValueError(
-            f"expected {config.num_sparse} sparse features, got "
-            f"{arr.shape[-1]}")
-    mins = arr.min(axis=0)
-    maxs = arr.max(axis=0)
-    for i, vocab in enumerate(config.vocab_sizes):
-        if mins[i] < 0 or maxs[i] >= vocab:
+    if isinstance(sparse, (list, tuple)):
+        if len(sparse) != config.num_sparse:
             raise ValueError(
-                f"sparse feature {i} has indices in [{mins[i]}, {maxs[i]}] "
+                f"expected {config.num_sparse} sparse columns, got "
+                f"{len(sparse)}")
+        columns = [np.asarray(c).reshape(-1) for c in sparse]
+    else:
+        arr = np.asarray(sparse)
+        if arr.shape[-1] != config.num_sparse:
+            raise ValueError(
+                f"expected {config.num_sparse} sparse features, got "
+                f"{arr.shape[-1]}")
+        columns = [arr[:, i] for i in range(config.num_sparse)]
+    for i, (col, vocab) in enumerate(zip(columns, config.vocab_sizes)):
+        lo, hi = col.min(), col.max()
+        if lo < 0 or hi >= vocab:
+            raise ValueError(
+                f"sparse feature {i} has indices in [{lo}, {hi}] "
                 f"outside vocab [0, {vocab})")
 
 

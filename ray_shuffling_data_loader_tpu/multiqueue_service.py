@@ -113,8 +113,18 @@ class QueueServer:
                     conn.sendall(_RESPONSE.pack(KIND_FAILURE, len(text)))
                     conn.sendall(text)
                 else:
-                    table = item.result() if hasattr(item, "result") else item
-                    payload = _serialize(table)
+                    try:
+                        table = (item.result() if hasattr(item, "result")
+                                 else item)
+                        payload = _serialize(table)
+                    except Exception as e:  # noqa: BLE001 - forwarded
+                        # A failed shuffle task ref: the consumer gets the
+                        # real cause as a failure frame, not a dead socket.
+                        text = repr(e).encode()
+                        conn.sendall(
+                            _RESPONSE.pack(KIND_FAILURE, len(text)))
+                        conn.sendall(text)
+                        continue
                     conn.sendall(_RESPONSE.pack(KIND_TABLE, payload.size))
                     conn.sendall(payload)
         except (ConnectionError, OSError) as e:

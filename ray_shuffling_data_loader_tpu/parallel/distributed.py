@@ -178,9 +178,13 @@ def shuffle_epoch_distributed(epoch: int,
                         map_transform, file_cache)
         for fi in local_file_indices
     }
+    # submit_once: a reduce consumes transport messages exactly once, so a
+    # retry would block on already-consumed tags until the recv timeout
+    # and mask the original error. Maps MAY retry (duplicate sends are
+    # dropped by the receiving transport).
     reduce_refs: Dict[int, ex.TaskRef] = {
-        r: pool.submit(_reduce_task, r, seed, epoch, plan, transport,
-                       map_refs, stats_collector, reduce_transform)
+        r: pool.submit_once(_reduce_task, r, seed, epoch, plan, transport,
+                            map_refs, stats_collector, reduce_transform)
         for r in plan.local_reducers(transport.host_id)
     }
     for local_rank, trainer in enumerate(plan.local_trainers(transport.host_id)):

@@ -83,3 +83,19 @@ def test_dlrm_e2e_narrow_dtypes(tmp_parquet_dir):
     assert len(losses) == 12  # 2 epochs x 600/100 batches
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_validate_sparse_batch_accepts_both_layouts(rng):
+    cfg = dlrm.DLRMConfig(vocab_sizes=(10, 20), embed_dim=4,
+                          top_hidden=(8,))
+    stacked = np.stack([rng.integers(0, v, 6) for v in cfg.vocab_sizes],
+                       axis=1)
+    dlrm.validate_sparse_batch(cfg, stacked)
+    cols = [stacked[:, 0:1].astype(np.int8), stacked[:, 1:2].astype(np.int8)]
+    dlrm.validate_sparse_batch(cfg, cols)
+    bad = [cols[0], (cols[1] + 20).astype(np.int8)]
+    import pytest
+    with pytest.raises(ValueError, match="outside vocab"):
+        dlrm.validate_sparse_batch(cfg, bad)
+    with pytest.raises(ValueError, match="columns"):
+        dlrm.validate_sparse_batch(cfg, cols[:1])

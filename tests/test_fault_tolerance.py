@@ -243,3 +243,21 @@ def test_distributed_shuffle_fails_loudly_on_dead_peer(tmp_parquet_dir):
             transport=transports[0], max_concurrent_epochs=1, seed=0)
     assert time.monotonic() - start < 60
     transports[0].close()
+
+
+def test_failure_broadcast_evicts_into_full_bounded_queue():
+    """A full bounded queue still receives the failure marker: pending
+    items are evicted (the pipeline is dead, they are worthless), so a
+    consumer draining the buffer hits the marker instead of hanging."""
+    from ray_shuffling_data_loader_tpu import multiqueue as mq
+    from ray_shuffling_data_loader_tpu.dataset import (
+        ShuffleFailure, make_failure_broadcaster)
+
+    queue = mq.MultiQueue(2, 1, name=None)  # maxsize 1: both queues full
+    queue.put_nowait(0, "stale-batch")
+    queue.put_nowait(1, "stale-batch")
+    make_failure_broadcaster(queue, 2)(ValueError("boom"))
+    for queue_idx in range(2):
+        item = queue.get_nowait(queue_idx)
+        assert isinstance(item, ShuffleFailure)
+        assert "boom" in str(item.error)

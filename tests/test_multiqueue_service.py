@@ -103,3 +103,23 @@ def test_separate_process_trainer_rendezvous(tmp_parquet_dir):
         assert "ROWS 120 UNIQUE 120" in proc.stdout, proc.stdout
     shuffle_result.result()
     queue.shutdown()
+
+
+def test_failed_ref_crosses_wire_as_failure_frame():
+    """A queued ref whose task failed reaches the remote consumer as a
+    KIND_FAILURE frame carrying the real cause, not a dead socket."""
+    from ray_shuffling_data_loader_tpu import executor as ex
+
+    queue = mq.MultiQueue(1, name=None)
+    with ex.Executor(num_workers=1) as pool:
+        def boom():
+            raise ValueError("real cause")
+        ref = pool.submit(boom)
+        with pytest.raises(ValueError):
+            ref.result()
+        queue.put(0, ref)
+        with svc.serve_queue(queue) as server:
+            with svc.RemoteQueue(server.address) as remote:
+                failure = remote.get(0)
+                assert isinstance(failure, ShuffleFailure)
+                assert "real cause" in str(failure.error)
