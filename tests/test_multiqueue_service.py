@@ -123,3 +123,34 @@ def test_failed_ref_crosses_wire_as_failure_frame():
                 failure = remote.get(0)
                 assert isinstance(failure, ShuffleFailure)
                 assert "real cause" in str(failure.error)
+
+
+def test_jax_dataset_over_remote_queue(tmp_parquet_dir):
+    """Full remote-trainer topology: RemoteQueue -> JaxShufflingDataset ->
+    device-resident batches (the reference's Horovod-worker pattern)."""
+    import numpy as np
+
+    from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
+
+    filenames, _ = dg.generate_data_local(160, 2, 1, 0.0, tmp_parquet_dir)
+    queue, shuffle_result = create_batch_queue_and_shuffle(
+        filenames, 1, num_trainers=1, batch_size=40,
+        max_concurrent_epochs=1, num_reducers=2, seed=11,
+        queue_name="svc-jax-test")
+    with svc.serve_queue(queue) as server:
+        remote = svc.RemoteQueue(server.address)
+        ds = JaxShufflingDataset(
+            filenames, num_epochs=1, num_trainers=1, batch_size=40, rank=0,
+            num_reducers=2, batch_queue=remote, shuffle_result=None,
+            feature_columns=list(dg.FEATURE_COLUMNS),
+            feature_types=[np.int32] * len(dg.FEATURE_COLUMNS),
+            label_column=dg.LABEL_COLUMN, drop_last=True)
+        ds.set_epoch(0)
+        rows = 0
+        for features, label in ds:
+            assert features[0].shape == (40, 1)
+            rows += label.shape[0]
+        assert rows == 160
+        remote.close()
+    shuffle_result.result()
+    queue.shutdown()
