@@ -108,13 +108,20 @@ def generate_imagenet_parquet(num_images: int,
 def decode_transform(height: int,
                      width: int,
                      channels: int = 3,
-                     image_column: str = IMAGE_COLUMN):
+                     image_column: str = IMAGE_COLUMN,
+                     resize: bool = False):
     """ReduceTransform: encoded-bytes column -> FixedSizeList<uint8> pixels.
 
     Runs inside each reduce task on its shuffled output (shuffle.py
     ``reduce_transform``), so decode cost is spread across the reducer pool
-    and overlaps training. Rejects size mismatches loudly — fixed shapes
-    are a TPU invariant, not a preference.
+    and overlaps training.
+
+    ``resize=False`` (synthetic/pre-sized shards): sources must decode to
+    exactly (height, width, channels) — enforced loudly, fixed shapes are
+    a TPU invariant — and the threaded C++ decoder (native/image.py) is
+    used when available. ``resize=True`` (real ImageNet-style corpora with
+    ragged source sizes): every image is bilinearly resized to the target
+    shape via PIL.
     """
     expected_shape = (height, width, channels)
     flat_len = height * width * channels
@@ -126,19 +133,21 @@ def decode_transform(height: int,
             image = Image.open(io.BytesIO(payload))
             if channels == 3:
                 image = image.convert("RGB")
+            if resize and image.size != (width, height):
+                image = image.resize((width, height), Image.BILINEAR)
             arr = np.asarray(image, dtype=np.uint8)
             if arr.shape != expected_shape:
                 raise ValueError(
                     f"decoded image shape {arr.shape} != expected "
-                    f"{expected_shape}; resize at generation time — "
-                    "the TPU pipeline requires fixed shapes")
+                    f"{expected_shape}; resize at generation time or pass "
+                    "resize=True — the TPU pipeline requires fixed shapes")
             out[i] = arr.reshape(-1)
         return out
 
     def transform(table: pa.Table) -> pa.Table:
         from ray_shuffling_data_loader_tpu.native import image as native_image
         payloads = table.column(image_column).to_pylist()
-        if channels == 3 and native_image.available():
+        if not resize and channels == 3 and native_image.available():
             # Threaded libjpeg/libpng batch decode (C++); PIL otherwise.
             out = native_image.decode_batch(payloads, height, width)
         else:
@@ -153,7 +162,8 @@ def decode_transform(height: int,
 
 def imagenet_spec(height: int,
                   width: int,
-                  channels: int = 3) -> Dict[str, Any]:
+                  channels: int = 3,
+                  resize: bool = False) -> Dict[str, Any]:
     """``JaxShufflingDataset`` kwargs for the decoded-image layout."""
     return {
         "feature_columns": [IMAGE_COLUMN],
@@ -161,7 +171,8 @@ def imagenet_spec(height: int,
         "feature_types": [np.uint8],
         "label_column": LABEL_COLUMN,
         "label_type": np.int32,
-        "reduce_transform": decode_transform(height, width, channels),
+        "reduce_transform": decode_transform(height, width, channels,
+                                             resize=resize),
     }
 
 

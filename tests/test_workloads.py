@@ -231,3 +231,32 @@ def test_reduce_transform_exactly_once_per_row(tmp_parquet_dir):
     rows = sum(t.num_rows for t in ds)
     assert rows == 200
     assert sorted(seen) == list(range(200))
+
+
+def test_decode_transform_resizes_ragged_sources(tmp_parquet_dir):
+    """resize=True handles real-corpus ragged image sizes: every decoded
+    row comes out at the fixed target shape."""
+    import pyarrow as pa
+    from PIL import Image
+
+    rng = np.random.default_rng(1)
+    payloads = []
+    for h, w in [(8, 8), (13, 9), (32, 17)]:
+        buf = io.BytesIO()
+        Image.fromarray(
+            rng.integers(0, 256, (h, w, 3)).astype(np.uint8)).save(
+                buf, format="png")
+        payloads.append(buf.getvalue())
+    table = pa.table({
+        imagenet.IMAGE_COLUMN: pa.array(payloads, type=pa.binary()),
+        imagenet.LABEL_COLUMN: np.zeros(3, np.int64),
+        imagenet.KEY_COLUMN: np.arange(3, dtype=np.int64),
+    })
+    decoded = imagenet.decode_transform(16, 16, resize=True)(table)
+    col = decoded.column(imagenet.IMAGE_COLUMN)
+    for i in range(3):
+        arr = np.asarray(col[i].as_py(), np.uint8)
+        assert arr.size == 16 * 16 * 3
+    # Without resize, ragged sources are rejected loudly.
+    with pytest.raises(ValueError, match="fixed shapes"):
+        imagenet.decode_transform(16, 16)(table)
