@@ -111,11 +111,16 @@ def main() -> None:
     # for free on device (workloads/dlrm_criteo.py).
     from ray_shuffling_data_loader_tpu.workloads.dlrm_criteo import dlrm_spec
 
+    # Deeper prefetch keeps more host->device transfers in flight — on a
+    # tunneled/high-latency device link this hides most of the copy time.
+    prefetch_size = int(os.environ.get("RSDL_BENCH_PREFETCH", 4))
+
     ds = JaxShufflingDataset(
         filenames, num_epochs=num_epochs, num_trainers=1,
         batch_size=batch_size, rank=0,
         num_reducers=num_reducers, max_concurrent_epochs=2, seed=0,
-        queue_name="bench-queue", drop_last=True, **dlrm_spec())
+        queue_name="bench-queue", drop_last=True,
+        prefetch_size=prefetch_size, **dlrm_spec())
 
     # Tiny jitted reduction per batch: forces the batch to land on device;
     # negligible compute (sparse-feature columns arrive as one pytree
