@@ -16,6 +16,13 @@ this module defines the TPU-native recipe:
   traffic than float32; the model casts on device (models/resnet.py).
 - Images stay uint8 end-to-end on the host; normalization belongs in the
   first device op where it is fused by XLA.
+- Trade-off knob: passing :func:`decode_transform` as ``map_transform``
+  instead decodes ONCE per file (the decoded pixels then ride the file
+  cache across epochs) at the cost of shuffling ~H*W*3 bytes/row instead
+  of the compressed payload — better when epochs >> RAM pressure, worse
+  when the corpus is large relative to host memory. The default
+  (``reduce_transform``) shuffles compressed bytes and re-decodes per
+  epoch on the reducer pool.
 """
 
 from __future__ import annotations
