@@ -171,6 +171,8 @@ def shuffle_epoch_distributed(epoch: int,
     """One epoch on this host: map local files, reduce owned reducers,
     feed local trainers. Returns refs whose completion implies every
     cross-host send of this host's chunks has finished."""
+    if stats_collector is not None:
+        stats_collector.epoch_start(epoch)
     local_file_indices = plan.local_files(transport.host_id)
     map_refs: Dict[int, ex.TaskRef] = {
         fi: pool.submit(_map_task, filenames[fi], fi, plan.num_reducers,
@@ -211,7 +213,8 @@ def shuffle_distributed(filenames: Sequence[str],
                         map_transform=None,
                         file_cache="auto",
                         reduce_transform=None,
-                        task_retries: int = 0) -> float:
+                        task_retries: int = 0,
+                        collect_stats: bool = False):
     """Multi-epoch pipelined distributed shuffle driver for ONE host.
 
     Run with the same arguments on every host of the world (SPMD); hosts
@@ -219,13 +222,30 @@ def shuffle_distributed(filenames: Sequence[str],
     throttle (``max_concurrent_epochs``) mirrors the reference driver's
     (reference: shuffle.py:103-140); a host cannot run ahead unboundedly
     because its reducers block on every peer's chunks for the oldest
-    in-flight epoch. Returns wall-clock duration in seconds.
+    in-flight epoch. Returns wall-clock duration in seconds, or — with
+    ``collect_stats`` — THIS host's ``TrialStats`` (its local maps/
+    reduces/consumes; aggregate across hosts by summing the per-host CSVs,
+    the analog of the reference's per-node stage spans).
     """
+    from ray_shuffling_data_loader_tpu import stats as stats_mod
+
     if not 0 <= start_epoch <= num_epochs:
         raise ValueError(
             f"start_epoch {start_epoch} out of range [0, {num_epochs}]")
     plan = ShardPlan(len(filenames), num_reducers, transport.world,
                      trainers_per_host)
+    stats_collector = None
+    if collect_stats:
+        if start_epoch:
+            raise ValueError(
+                "collect_stats with start_epoch > 0 is unsupported (stats "
+                "collectors assume all epochs run)")
+        stats_collector = stats_mod.TrialStatsCollector(
+            num_epochs,
+            num_maps=len(plan.local_files(transport.host_id)),
+            num_reduces=len(plan.local_reducers(transport.host_id)),
+            num_consumes=trainers_per_host)
+        stats_collector.trial_start()
     if file_cache == "auto":
         file_cache = (sh.default_file_cache()
                       if num_epochs - start_epoch > 1 else None)
@@ -237,15 +257,22 @@ def shuffle_distributed(filenames: Sequence[str],
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}
         for epoch_idx in range(start_epoch, num_epochs):
+            throttle_start = timeit.default_timer()
             while len(in_progress) >= max_concurrent_epochs:
                 oldest = min(in_progress)
                 refs = in_progress.pop(oldest)
                 ex.wait(refs, num_returns=len(refs))
                 for ref in refs:
                     ref.result()
+            if stats_collector is not None:
+                throttle_duration = timeit.default_timer() - throttle_start
+                if throttle_duration > 1e-4:
+                    stats_collector.throttle_done(epoch_idx,
+                                                  throttle_duration)
             in_progress[epoch_idx] = shuffle_epoch_distributed(
                 epoch_idx, filenames, batch_consumer, plan, transport, pool,
-                seed, start, map_transform=map_transform,
+                seed, start, stats_collector=stats_collector,
+                map_transform=map_transform,
                 file_cache=file_cache, reduce_transform=reduce_transform)
         for epoch_idx in sorted(in_progress):
             refs = in_progress.pop(epoch_idx)
@@ -255,6 +282,9 @@ def shuffle_distributed(filenames: Sequence[str],
     finally:
         if owns_pool:
             pool.shutdown()
+    if stats_collector is not None:
+        stats_collector.trial_done()
+        return stats_collector.get_stats()
     return timeit.default_timer() - start
 
 

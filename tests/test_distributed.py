@@ -400,3 +400,49 @@ def test_distributed_shuffle_applies_reduce_transform(tmp_path):
             keys.extend(table.column(dg.KEY_COLUMN).to_pylist())
     assert sorted(keys) == list(range(120))
     assert sorted(seen) == list(range(120))
+
+
+def test_distributed_shuffle_collects_per_host_stats(tmp_path):
+    """collect_stats=True returns this host's TrialStats with the local
+    map/reduce/consume counts (per-host observability parity)."""
+    import threading
+
+    from ray_shuffling_data_loader_tpu import data_generation as dg
+    from ray_shuffling_data_loader_tpu import stats as stats_mod
+    from ray_shuffling_data_loader_tpu.parallel import distributed as dist
+    from ray_shuffling_data_loader_tpu.parallel import transport as tr
+
+    filenames, _ = dg.generate_data_local(120, 4, 1, 0.0,
+                                          str(tmp_path / "pq"))
+    world = 2
+    transports = tr.create_local_transports(world)
+    results = {}
+
+    def run_host(host):
+        def consumer(rank, epoch, refs):
+            if refs is not None:
+                for ref in refs:
+                    ref.result()
+
+        results[host] = dist.shuffle_distributed(
+            filenames, consumer, num_epochs=2, num_reducers=4,
+            transport=transports[host], max_concurrent_epochs=1, seed=1,
+            collect_stats=True)
+
+    threads = [threading.Thread(target=run_host, args=(h,))
+               for h in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+        assert not t.is_alive()
+    for t in transports:
+        t.close()
+    for host in range(world):
+        stats = results[host]
+        assert isinstance(stats, stats_mod.TrialStats)
+        assert stats.duration > 0
+        assert len(stats.epoch_stats) == 2
+        epoch0 = stats.epoch_stats[0]
+        assert len(epoch0.map_stats.task_durations) == 2   # 4 files / 2
+        assert len(epoch0.reduce_stats.task_durations) == 2  # 4 red / 2
