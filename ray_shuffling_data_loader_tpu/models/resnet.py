@@ -33,6 +33,9 @@ class ResNetConfig:
     num_classes: int = 1000
     num_groups: int = 32
     compute_dtype: Any = jnp.bfloat16
+    # Rematerialize each residual block in the backward pass
+    # (jax.checkpoint): ~30% extra FLOPs for O(depth) less activation HBM.
+    remat: bool = False
 
 
 def resnet50(num_classes: int = 1000) -> ResNetConfig:
@@ -149,29 +152,42 @@ def apply(config: ResNetConfig, params: Dict[str, Any],
     x = jax.nn.relu(x)
     x = jax.lax.reduce_window(x, -jnp.inf, jax.lax.max, (1, 3, 3, 1),
                               (1, 2, 2, 1), "SAME")
+    def block_fn(x, block_params, stride, has_proj):
+        residual = x
+        y = _conv(x, block_params["conv1"])
+        y = _group_norm(y, block_params["gn1"]["scale"],
+                        block_params["gn1"]["bias"], config.num_groups)
+        y = jax.nn.relu(y)
+        y = _conv(y, block_params["conv2"], stride=stride)
+        y = _group_norm(y, block_params["gn2"]["scale"],
+                        block_params["gn2"]["bias"], config.num_groups)
+        y = jax.nn.relu(y)
+        y = _conv(y, block_params["conv3"])
+        y = _group_norm(y, block_params["gn3"]["scale"],
+                        block_params["gn3"]["bias"], config.num_groups)
+        if has_proj:
+            residual = _conv(residual, block_params["proj"], stride=stride)
+            residual = _group_norm(residual,
+                                   block_params["proj_gn"]["scale"],
+                                   block_params["proj_gn"]["bias"],
+                                   config.num_groups)
+        return jax.nn.relu(y + residual)
+
+    if config.remat:
+        block_fn = jax.checkpoint(block_fn, static_argnums=(2, 3))
     for stage, num_blocks in enumerate(config.stage_sizes):
         for block in range(num_blocks):
             name = f"s{stage}b{block}"
             stride = 2 if (stage > 0 and block == 0) else 1
-            residual = x
-            y = _conv(x, params[f"{name}_conv1"])
-            y = _group_norm(y, params[f"{name}_gn1"]["scale"],
-                            params[f"{name}_gn1"]["bias"], config.num_groups)
-            y = jax.nn.relu(y)
-            y = _conv(y, params[f"{name}_conv2"], stride=stride)
-            y = _group_norm(y, params[f"{name}_gn2"]["scale"],
-                            params[f"{name}_gn2"]["bias"], config.num_groups)
-            y = jax.nn.relu(y)
-            y = _conv(y, params[f"{name}_conv3"])
-            y = _group_norm(y, params[f"{name}_gn3"]["scale"],
-                            params[f"{name}_gn3"]["bias"], config.num_groups)
-            if f"{name}_proj" in params:
-                residual = _conv(residual, params[f"{name}_proj"],
-                                 stride=stride)
-                residual = _group_norm(
-                    residual, params[f"{name}_proj_gn"]["scale"],
-                    params[f"{name}_proj_gn"]["bias"], config.num_groups)
-            x = jax.nn.relu(y + residual)
+            has_proj = f"{name}_proj" in params
+            block_params = {
+                key: params[f"{name}_{key}"]
+                for key in ("conv1", "gn1", "conv2", "gn2", "conv3", "gn3")
+            }
+            if has_proj:
+                block_params["proj"] = params[f"{name}_proj"]
+                block_params["proj_gn"] = params[f"{name}_proj_gn"]
+            x = block_fn(x, block_params, stride, has_proj)
     x = x.mean(axis=(1, 2))  # global average pool
     logits = (x @ params["fc_w"].astype(dtype)
               + params["fc_b"].astype(dtype))
