@@ -17,7 +17,8 @@ same run.
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Env knobs: RSDL_BENCH_ROWS, RSDL_BENCH_FILES, RSDL_BENCH_EPOCHS,
-RSDL_BENCH_BATCH, RSDL_BENCH_CPU=1 (force CPU backend for smoke runs),
+RSDL_BENCH_BATCH, RSDL_BENCH_PREFETCH (batches in flight, default 4),
+RSDL_BENCH_CPU=1 (force CPU backend for smoke runs),
 RSDL_BENCH_DATA (data cache dir).
 """
 

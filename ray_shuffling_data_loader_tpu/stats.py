@@ -127,6 +127,12 @@ class EpochStatsCollector:
         self._stage_duration: Dict[str, Optional[float]] = {
             "map": None, "reduce": None, "consume": None}
         self._done_event = threading.Event()
+        if num_reduces == 0:
+            # A host owning zero reducers (more hosts than reducers in the
+            # distributed plan) has nothing to wait for: its epochs are
+            # born complete, otherwise get_stats would block forever.
+            self._duration = 0.0
+            self._done_event.set()
 
     def epoch_start(self) -> None:
         with self._lock:
@@ -140,7 +146,9 @@ class EpochStatsCollector:
             self._maps_done += 1
             self._map_durations.append(duration)
             self._read_durations.append(read_duration)
-            if self._maps_done == self._num_maps:
+            # ">=": a retried task (Executor task_retries) may re-record a
+            # completion; the last-done edge extends to the latest one.
+            if self._maps_done >= self._num_maps:
                 self._stage_done_locked("map")
 
     def reduce_start(self) -> None:
@@ -150,10 +158,10 @@ class EpochStatsCollector:
         with self._lock:
             self._reduces_done += 1
             self._reduce_durations.append(duration)
-            if self._reduces_done == self._num_reduces:
+            if self._reduces_done >= self._num_reduces:
                 self._stage_done_locked("reduce")
                 # Epoch "shuffle done" edge = last reduce done
-                # (reference: stats.py:152-156).
+                # (reference: stats.py:152-156); a retried reduce extends it.
                 assert self._epoch_start_time is not None
                 self._duration = (timeit.default_timer()
                                   - self._epoch_start_time)
@@ -168,7 +176,7 @@ class EpochStatsCollector:
             self._consumes_done += 1
             self._consume_durations.append(duration)
             self._consume_times.append(trial_time_to_consume)
-            if self._consumes_done == self._num_consumes:
+            if self._consumes_done >= self._num_consumes:
                 self._stage_done_locked("consume")
 
     def throttle_done(self, duration: float) -> None:
@@ -193,9 +201,10 @@ class EpochStatsCollector:
 
     def get_stats(self) -> EpochStats:
         with self._lock:
-            assert self._maps_done == self._num_maps, (
+            # ">=": task retries may record extra completions.
+            assert self._maps_done >= self._num_maps, (
                 f"epoch incomplete: {self._maps_done}/{self._num_maps} maps")
-            assert self._reduces_done == self._num_reduces, (
+            assert self._reduces_done >= self._num_reduces, (
                 f"epoch incomplete: {self._reduces_done}/{self._num_reduces}"
                 " reduces")
             return EpochStats(

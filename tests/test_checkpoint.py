@@ -341,8 +341,6 @@ def test_combined_resume_matches_uninterrupted_run(tmp_path):
         pass
     trainer = _T()
     trainer.params, trainer.opt_state = params, opt_state
-    trainer.mesh = None
-    import jax.sharding
     trainer.mesh = jax.sharding.Mesh(np.asarray(jax.devices()[:1]), ("d",))
     with ckpt.TrainStateCheckpointer(str(tmp_path / "ck")) as saver:
         saver.save(crash_after, trainer, loader_checkpoint=loader)

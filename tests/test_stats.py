@@ -103,3 +103,36 @@ def test_process_stats_writes_reference_schema(tmp_path):
         erows = list(csv.DictReader(f))
     assert len(erows) == 2
     assert list(erows[0].keys()) == st.EPOCH_FIELDNAMES
+
+
+def test_epoch_collector_tolerates_retry_overcounts():
+    """Task retries may re-record completions; stats must not assert."""
+    c = st.EpochStatsCollector(num_maps=2, num_reduces=1, num_consumes=1)
+    c.epoch_start()
+    for _ in range(3):  # one retry duplicate
+        c.map_start()
+        c.map_done(0.1, 0.05)
+    c.reduce_start()
+    c.reduce_done(0.2)
+    c.reduce_start()
+    c.reduce_done(0.2)  # retried reduce after the epoch looked done
+    c.consume_start()
+    c.consume_done(0.01, 0.5)
+    assert c.wait_until_done(timeout=1)
+    epoch = c.get_stats()
+    assert len(epoch.map_stats.task_durations) == 3
+    assert epoch.duration >= 0
+
+
+def test_epoch_collector_zero_reduces_is_born_complete():
+    """A host owning zero reducers (distributed plan with more hosts than
+    reducers) must not block forever in get_stats."""
+    c = st.EpochStatsCollector(num_maps=1, num_reduces=0, num_consumes=1)
+    c.epoch_start()
+    c.map_start()
+    c.map_done(0.1, 0.05)
+    c.consume_start()
+    c.consume_done(0.0, 0.0)
+    assert c.wait_until_done(timeout=1)
+    epoch = c.get_stats()
+    assert epoch.reduce_stats.task_durations == []
