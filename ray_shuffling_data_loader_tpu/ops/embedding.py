@@ -19,9 +19,11 @@ non-matmul op. Three strategies, dispatched by :func:`lookup`:
   selects the table row to DMA HBM->VMEM, overlapping row fetches with the
   pipeline. Backward is an XLA scatter-add via ``custom_vjp``.
 
-``auto`` picks ``one_hot`` for vocab <= ONE_HOT_MAX_VOCAB else ``take``
-(the Pallas path is opt-in until it wins on-chip benchmarks:
-benchmarks/bench_embedding.py measures all three).
+``auto`` picks ``one_hot`` for vocab <= ONE_HOT_MAX_VOCAB; above it, the
+Pallas gather on a real TPU when the embed dim is 128-lane aligned
+(kernel-isolated on-chip measurement: ~25-30% faster than XLA gather at
+vocab 1M / embed 128 / batch 64k — benchmarks/bench_embedding.py), else
+XLA ``take``.
 """
 
 from __future__ import annotations
@@ -147,6 +149,14 @@ def pallas_lookup(table: jax.Array, indices: jax.Array,
     return _pallas_gather(table, indices, interpret).astype(dtype)
 
 
+def _auto_mode(vocab: int, embed_dim: int) -> str:
+    if vocab <= ONE_HOT_MAX_VOCAB:
+        return "one_hot"
+    if jax.default_backend() == "tpu" and embed_dim % 128 == 0:
+        return "pallas"
+    return "take"
+
+
 def lookup(table: jax.Array,
            indices: jax.Array,
            dtype: Any,
@@ -156,7 +166,7 @@ def lookup(table: jax.Array,
     and return bit-identical results; they differ only in which hardware
     unit does the work."""
     if mode == "auto":
-        mode = ("one_hot" if table.shape[0] <= ONE_HOT_MAX_VOCAB else "take")
+        mode = _auto_mode(table.shape[0], table.shape[1])
     if mode == "take":
         return take_lookup(table, indices, dtype)
     if mode == "one_hot":

@@ -128,3 +128,15 @@ def test_dlrm_train_step_with_pallas_lookup(rng):
         losses.append(float(loss))
     assert all(np.isfinite(losses))
     assert losses[-1] < losses[0]
+
+
+def test_auto_mode_dispatch_rules(monkeypatch):
+    small_v = embedding.ONE_HOT_MAX_VOCAB
+    large_v = embedding.ONE_HOT_MAX_VOCAB + 1
+    monkeypatch.setattr(jax, "default_backend", lambda: "cpu")
+    assert embedding._auto_mode(small_v, 128) == "one_hot"
+    assert embedding._auto_mode(large_v, 128) == "take"
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    assert embedding._auto_mode(small_v, 128) == "one_hot"
+    assert embedding._auto_mode(large_v, 128) == "pallas"
+    assert embedding._auto_mode(large_v, 32) == "take"  # unaligned rows
