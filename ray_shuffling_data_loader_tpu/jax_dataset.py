@@ -230,6 +230,19 @@ class JaxShufflingDataset:
             reduce task on its shuffled output — e.g. image decode inside
             the reducers (``workloads.imagenet.decode_transform``). Only
             effective when this dataset launches the shuffle.
+        persistent_prefetch: keep ONE producer thread alive across epochs
+            (default). The producer rolls straight from epoch N's last
+            batch into epoch N+1's convert+transfer, so the epoch boundary
+            costs the consumer ~zero wait instead of a full
+            convert+transfer pipeline refill. Requires epochs to be
+            iterated sequentially from ``start_epoch`` (the universal
+            pattern; ``set_epoch`` raises otherwise). Set False to restore
+            a fresh producer per epoch (any epoch order, at the price of
+            the boundary bubble).
+        file_cache: forwarded to the shuffle driver (rank-0 launch path
+            only): a ``shuffle.FileTableCache``, ``"auto"`` (budgeted from
+            host RAM), or ``None`` to disable cross-epoch caching of
+            decoded files.
     """
 
     def __init__(self,
@@ -260,7 +273,9 @@ class JaxShufflingDataset:
                  start_epoch: int = 0,
                  stack_features: bool = False,
                  cast_at_map: bool = True,
-                 reduce_transform=None):
+                 reduce_transform=None,
+                 persistent_prefetch: bool = True,
+                 file_cache="auto"):
         (self._feature_columns, self._feature_shapes, self._feature_types,
          self._label_column, self._label_shape, self._label_type) = (
              _normalize_jax_data_spec(feature_columns, feature_shapes,
@@ -290,16 +305,49 @@ class JaxShufflingDataset:
             max_batch_queue_size=max_batch_queue_size, seed=seed,
             num_workers=num_workers, queue_name=queue_name,
             start_epoch=start_epoch, map_transform=map_transform,
-            reduce_transform=reduce_transform)
+            reduce_transform=reduce_transform, file_cache=file_cache)
         self._mesh = mesh
         self._data_axis = data_axis
         self._prefetch_size = max(1, prefetch_size)
         self._device_put = device_put
         self._device_concat = None  # jitted column concat, built lazily
         self.batch_wait_stats = BatchWaitStats()
+        # Persistent-prefetch state (one producer thread for ALL epochs).
+        self._persistent = persistent_prefetch
+        self._lock = threading.Lock()
+        self._out: Optional[_queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._pending_skips: dict = {}   # epoch -> skip_batches (pre-start)
+        self._started_epochs: set = set()  # epochs the producer entered
+        self._consumer_skip = 0          # device batches to drop client-side
+        self._next_epoch = self._dataset.start_epoch  # next to consume
+        self._epoch_set = False          # set_epoch called since last iter
 
     def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
-        self._dataset.set_epoch(epoch, skip_batches=skip_batches)
+        if not self._persistent:
+            self._dataset.set_epoch(epoch, skip_batches=skip_batches)
+            return
+        if skip_batches < 0:
+            raise ValueError(f"skip_batches must be >= 0, got {skip_batches}")
+        if epoch != self._next_epoch:
+            raise ValueError(
+                f"persistent_prefetch requires sequential epochs: expected "
+                f"set_epoch({self._next_epoch}), got set_epoch({epoch}). "
+                "Construct with persistent_prefetch=False for out-of-order "
+                "epoch iteration.")
+        with self._lock:
+            if epoch in self._started_epochs:
+                # Producer already ran (or is running) this epoch's convert+
+                # transfer; drop the first N finished batches client-side.
+                self._consumer_skip = skip_batches
+            else:
+                # Cheap path: the producer will skip at the Arrow-slice
+                # level, before any conversion or transfer.
+                if skip_batches:
+                    self._pending_skips[epoch] = skip_batches
+                self._consumer_skip = 0
+        self._epoch_set = True
 
     @property
     def batch_size(self) -> int:
@@ -369,7 +417,10 @@ class JaxShufflingDataset:
         A background thread runs convert+device_put ``prefetch_size`` batches
         ahead; ``jax.device_put`` is async (returns before the copy lands),
         so the host->device DMA for batch N+1 overlaps the consumer's
-        compute on batch N.
+        compute on batch N. With ``persistent_prefetch`` (default) that
+        thread lives for ALL epochs: when epoch N's tables run out it rolls
+        straight into epoch N+1, so the consumer's first batch of the new
+        epoch is typically already on device.
         """
         if self._device_put:
             # Force backend init on the calling thread: some PJRT plugins
@@ -377,6 +428,99 @@ class JaxShufflingDataset:
             # initialization happens on a worker thread.
             import jax
             jax.local_devices()
+        if self._persistent:
+            yield from self._iter_persistent()
+        else:
+            yield from self._iter_single_epoch()
+
+    # -- persistent (cross-epoch) producer ---------------------------------
+
+    def _persistent_put(self, item) -> bool:
+        """Bounded put that gives up when the dataset is closed."""
+        while not self._stop.is_set():
+            try:
+                self._out.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    def _producer_loop(self) -> None:
+        try:
+            for epoch in range(self._dataset.start_epoch,
+                               self._dataset.num_epochs):
+                with self._lock:
+                    self._started_epochs.add(epoch)
+                    skip = self._pending_skips.pop(epoch, 0)
+                self._dataset.set_epoch(epoch, skip_batches=skip)
+                for table in self._dataset:
+                    with trace_span("batch_convert"):
+                        arrays = self._convert(table)
+                    with trace_span("batch_transfer"):
+                        batch = self._transfer(arrays)
+                    if not self._persistent_put(("batch", epoch, batch)):
+                        return
+                if not self._persistent_put(("end", epoch, None)):
+                    return
+        except BaseException as e:  # noqa: BLE001 - forwarded to consumer
+            self._persistent_put(e)
+
+    def _iter_persistent(self) -> Iterator[Tuple[List[Any], Any]]:
+        if not self._epoch_set:
+            raise ValueError(
+                "You must set the epoch on this dataset via set_epoch() at "
+                "the beginning of each epoch, before iterating over this "
+                "dataset (e.g. via enumerate(ds)).")
+        self._epoch_set = False
+        epoch = self._next_epoch
+        if self._thread is None:
+            self._out = _queue.Queue(maxsize=self._prefetch_size)
+            self._stop.clear()
+            self._thread = threading.Thread(target=self._producer_loop,
+                                            daemon=True,
+                                            name="rsdl-jax-prefetch")
+            self._thread.start()
+        while True:
+            wait_start = timeit.default_timer()
+            item = self._out.get()
+            self.batch_wait_stats.record(timeit.default_timer() - wait_start)
+            if isinstance(item, BaseException):
+                raise item
+            kind, item_epoch, payload = item
+            if item_epoch < epoch:
+                # Remnants of an epoch abandoned mid-iteration; batches were
+                # converted in vain but correctness needs them gone.
+                continue
+            assert item_epoch == epoch, (item_epoch, epoch)
+            if kind == "end":
+                break
+            if self._consumer_skip:
+                self._consumer_skip -= 1
+                continue
+            yield payload
+        self._next_epoch = epoch + 1
+
+    def close(self) -> None:
+        """Stop the persistent producer and drop buffered device batches.
+
+        Only needed when abandoning the dataset before its last epoch was
+        fully iterated; the producer exits on its own after the final
+        epoch. Idempotent.
+        """
+        self._stop.set()
+        if self._out is not None:
+            try:
+                while True:
+                    self._out.get_nowait()
+            except _queue.Empty:
+                pass
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- per-epoch producer (persistent_prefetch=False) --------------------
+
+    def _iter_single_epoch(self) -> Iterator[Tuple[List[Any], Any]]:
         out: _queue.Queue = _queue.Queue(maxsize=self._prefetch_size)
         SENTINEL = object()
         stop = threading.Event()
