@@ -19,7 +19,9 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 Env knobs: RSDL_BENCH_ROWS, RSDL_BENCH_FILES, RSDL_BENCH_EPOCHS,
 RSDL_BENCH_BATCH, RSDL_BENCH_PREFETCH (batches in flight, default 4),
 RSDL_BENCH_CPU=1 (force CPU backend for smoke runs),
-RSDL_BENCH_DATA (data cache dir).
+RSDL_BENCH_COLD=1 (disable the file-table cache so every epoch re-reads +
+re-decodes Parquet — the reference's 64 GB operating regime, where the
+corpus does not fit memory), RSDL_BENCH_DATA (data cache dir).
 """
 
 from __future__ import annotations
@@ -116,12 +118,19 @@ def main() -> None:
     # tunneled/high-latency device link this hides most of the copy time.
     prefetch_size = int(os.environ.get("RSDL_BENCH_PREFETCH", 4))
 
+    # Cold mode: no file-table cache, so the timed epochs pay Parquet read
+    # + decode every epoch (the regime of the reference's 64 GB runs,
+    # reference: benchmarks/benchmark_batch.sh:9-18). Default (cached) mode
+    # measures the steady state where the working set fits host memory.
+    cold = bool(os.environ.get("RSDL_BENCH_COLD"))
+
     ds = JaxShufflingDataset(
         filenames, num_epochs=num_epochs, num_trainers=1,
         batch_size=batch_size, rank=0,
         num_reducers=num_reducers, max_concurrent_epochs=2, seed=0,
         queue_name="bench-queue", drop_last=True,
-        prefetch_size=prefetch_size, **dlrm_spec())
+        prefetch_size=prefetch_size,
+        file_cache=None if cold else "auto", **dlrm_spec())
 
     # Tiny jitted reduction per batch: forces the batch to land on device;
     # negligible compute (sparse-feature columns arrive as one pytree
@@ -146,10 +155,18 @@ def main() -> None:
                     rows_consumed += label.shape[0]
             if epoch == 0 and num_epochs > 1:
                 jax.block_until_ready(last)
+                # Exclude warm-up/compile waits from the stall metric: the
+                # contract number (BASELINE.md: >=90% input-pipeline
+                # utilization) is about steady state, not first-compile.
+                ds.batch_wait_stats.reset()
                 start = timeit.default_timer()
         jax.block_until_ready(last)
     duration = max(timeit.default_timer() - start, 1e-9)
+    ds.close()
     pipeline_rows_per_s = rows_consumed / duration
+    wait = ds.batch_wait_stats.summary()
+    stall_s = wait["total"]
+    stall_pct = 100.0 * stall_s / duration
 
     # Best of two runs: the first warms the page cache, and taking the max
     # is fairest to the reference on a noisy shared host.
@@ -161,15 +178,26 @@ def main() -> None:
         for _ in range(2))
     print(f"# pipeline: {pipeline_rows_per_s:,.0f} rows/s | "
           f"pandas reference algo: {baseline_rows_per_s:,.0f} rows/s | "
-          f"stall {ds.batch_wait_stats.summary()['total']:.3f}s over "
-          f"{ds.batch_wait_stats.summary()['count']} batches",
+          f"stall {stall_s:.3f}s ({stall_pct:.2f}%) over "
+          f"{wait['count']} batches | mode: "
+          f"{'cold (decode every epoch)' if cold else 'cached'}",
           file=sys.stderr)
 
     print(json.dumps({
-        "metric": "shuffle_ingest_rows_per_sec_per_chip",
+        "metric": ("shuffle_ingest_rows_per_sec_per_chip_cold" if cold
+                   else "shuffle_ingest_rows_per_sec_per_chip"),
         "value": round(pipeline_rows_per_s, 1),
         "unit": "rows/s",
         "vs_baseline": round(pipeline_rows_per_s / baseline_rows_per_s, 3),
+        # Contract metric (BASELINE.md): consumer time spent waiting on the
+        # input pipeline, warm-up epoch excluded. <=10% == >=90% util.
+        "stall_pct": round(stall_pct, 3),
+        "stall_s": round(stall_s, 3),
+        "cache_mode": "cold" if cold else "cached",
+        # Fairness note: the pandas baseline is a rate over a quarter of
+        # the files (it is single-process and O(minutes) on the full set).
+        "baseline_files_fraction": round(len(baseline_files) /
+                                         len(filenames), 3),
     }))
 
 

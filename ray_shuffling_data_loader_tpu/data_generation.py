@@ -28,6 +28,7 @@ import pyarrow.parquet as pq
 
 from ray_shuffling_data_loader_tpu import executor as ex
 from ray_shuffling_data_loader_tpu import native
+from ray_shuffling_data_loader_tpu.utils import fileio
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
@@ -111,10 +112,10 @@ def generate_file(file_index: int, global_row_index: int,
             generate_row_group(group_index, global_row_index + group_start,
                                num_rows_in_group, seed=seed))
     table = pa.concat_tables(tables)
-    filename = os.path.join(data_dir,
-                            f"input_data_{file_index}.parquet.snappy")
-    pq.write_table(table, filename, compression="snappy",
-                   row_group_size=rows_per_group)
+    filename = fileio.join(data_dir,
+                           f"input_data_{file_index}.parquet.snappy")
+    fileio.write_parquet(table, filename, compression="snappy",
+                         row_group_size=rows_per_group)
     return filename, table.nbytes
 
 

@@ -120,7 +120,8 @@ def create_batch_queue_and_shuffle(
         start_epoch: int = 0,
         map_transform=None,
         reduce_transform=None,
-        task_retries: int = 0):
+        task_retries: int = 0,
+        file_cache="auto"):
     """Driver-mode helper: create the queue and start the shuffle before any
     trainer exists, so every rank can be a pure consumer
     (reference: dataset.py:17-51)."""
@@ -149,6 +150,7 @@ def create_batch_queue_and_shuffle(
         map_transform=map_transform,
         reduce_transform=reduce_transform,
         task_retries=task_retries,
+        file_cache=file_cache,
         on_failure=make_failure_broadcaster(batch_queue,
                                             num_epochs * num_trainers))
     return batch_queue, shuffle_result
@@ -188,7 +190,8 @@ class ShufflingDataset:
                  start_epoch: int = 0,
                  map_transform=None,
                  reduce_transform=None,
-                 task_retries: int = 0):
+                 task_retries: int = 0,
+                 file_cache="auto"):
         if num_reducers is None:
             num_reducers = default_num_reducers(num_trainers)
         self._batch_size = batch_size
@@ -205,7 +208,8 @@ class ShufflingDataset:
                         start_epoch=start_epoch,
                         map_transform=map_transform,
                         reduce_transform=reduce_transform,
-                        task_retries=task_retries))
+                        task_retries=task_retries,
+                        file_cache=file_cache))
                 self._owns_queue = True
             else:
                 self._batch_queue = mq.MultiQueue(

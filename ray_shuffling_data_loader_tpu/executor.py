@@ -11,8 +11,9 @@ have completed, preserving submission order in the done list.
 
 Multi-host scaling composes above this: each host of a TPU slice runs its own
 executor over its shard of the files (SPMD, see parallel/), so no cross-host
-task scheduler is needed — the one piece of Ray's C++ core that survives as
-an idea is plasma's ref-counted buffers, which live in native/.
+task scheduler is needed. Plasma's role — a shared, accounted buffer plane —
+is played by Arrow C++ buffers, with pipeline-wide byte accounting in
+``native.NativeBufferPool`` (see native/ and stats.py's pool_bytes column).
 """
 
 from __future__ import annotations

@@ -319,10 +319,12 @@ class JaxShufflingDataset:
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self._pending_skips: dict = {}   # epoch -> skip_batches (pre-start)
+        self._scheduled_skips: dict = {}  # epoch -> skip already producer-side
         self._started_epochs: set = set()  # epochs the producer entered
         self._consumer_skip = 0          # device batches to drop client-side
         self._next_epoch = self._dataset.start_epoch  # next to consume
         self._epoch_set = False          # set_epoch called since last iter
+        self._closed = False             # close() is terminal
 
     def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
         if not self._persistent:
@@ -339,13 +341,21 @@ class JaxShufflingDataset:
         with self._lock:
             if epoch in self._started_epochs:
                 # Producer already ran (or is running) this epoch's convert+
-                # transfer; drop the first N finished batches client-side.
-                self._consumer_skip = skip_batches
+                # transfer; drop the first N finished batches client-side —
+                # minus whatever a previous set_epoch call for this epoch
+                # already had the producer skip at the Arrow level.
+                already = self._scheduled_skips.get(epoch, 0)
+                self._consumer_skip = max(0, skip_batches - already)
             else:
                 # Cheap path: the producer will skip at the Arrow-slice
-                # level, before any conversion or transfer.
+                # level, before any conversion or transfer. Keep the two
+                # maps in lockstep so a repeated/reduced skip request
+                # neither double-drops nor leaves a stale pending skip.
                 if skip_batches:
                     self._pending_skips[epoch] = skip_batches
+                else:
+                    self._pending_skips.pop(epoch, None)
+                self._scheduled_skips[epoch] = skip_batches
                 self._consumer_skip = 0
         self._epoch_set = True
 
@@ -466,6 +476,11 @@ class JaxShufflingDataset:
             self._persistent_put(e)
 
     def _iter_persistent(self) -> Iterator[Tuple[List[Any], Any]]:
+        if self._closed:
+            raise RuntimeError(
+                "JaxShufflingDataset was closed; the persistent producer "
+                "cannot restart (it has already consumed the shuffle "
+                "queue). Construct a new dataset to iterate again.")
         if not self._epoch_set:
             raise ValueError(
                 "You must set the epoch on this dataset via set_epoch() at "
@@ -475,38 +490,51 @@ class JaxShufflingDataset:
         epoch = self._next_epoch
         if self._thread is None:
             self._out = _queue.Queue(maxsize=self._prefetch_size)
-            self._stop.clear()
             self._thread = threading.Thread(target=self._producer_loop,
                                             daemon=True,
                                             name="rsdl-jax-prefetch")
             self._thread.start()
-        while True:
-            wait_start = timeit.default_timer()
-            item = self._out.get()
-            self.batch_wait_stats.record(timeit.default_timer() - wait_start)
-            if isinstance(item, BaseException):
-                raise item
-            kind, item_epoch, payload = item
-            if item_epoch < epoch:
-                # Remnants of an epoch abandoned mid-iteration; batches were
-                # converted in vain but correctness needs them gone.
-                continue
-            assert item_epoch == epoch, (item_epoch, epoch)
-            if kind == "end":
-                break
-            if self._consumer_skip:
-                self._consumer_skip -= 1
-                continue
-            yield payload
-        self._next_epoch = epoch + 1
+        try:
+            while True:
+                wait_start = timeit.default_timer()
+                item = self._out.get()
+                self.batch_wait_stats.record(
+                    timeit.default_timer() - wait_start)
+                if isinstance(item, BaseException):
+                    raise item
+                kind, item_epoch, payload = item
+                if item_epoch < epoch:
+                    # Remnants of an epoch abandoned mid-iteration; batches
+                    # were converted in vain but correctness needs them gone.
+                    continue
+                assert item_epoch == epoch, (item_epoch, epoch)
+                if kind == "end":
+                    break
+                if self._consumer_skip:
+                    self._consumer_skip -= 1
+                    continue
+                yield payload
+        finally:
+            # Runs on normal completion AND on mid-epoch abandonment
+            # (GeneratorExit from iterator.close() / going out of scope):
+            # an abandoned epoch counts as consumed — the producer has
+            # already pulled its batches off the shuffle queue — so the
+            # legal next call is set_epoch(epoch + 1). Leftover in-flight
+            # batches of this epoch are dropped by the item_epoch < epoch
+            # guard above. A leftover skip must not eat the next epoch.
+            self._consumer_skip = 0
+            self._next_epoch = epoch + 1
 
     def close(self) -> None:
         """Stop the persistent producer and drop buffered device batches.
 
         Only needed when abandoning the dataset before its last epoch was
         fully iterated; the producer exits on its own after the final
-        epoch. Idempotent.
+        epoch. Idempotent, and terminal: iterating after close() raises
+        (the producer has already drained the underlying shuffle queue, so
+        a restarted one would replay or block forever).
         """
+        self._closed = True
         self._stop.set()
         if self._out is not None:
             try:

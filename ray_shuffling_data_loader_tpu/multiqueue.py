@@ -21,8 +21,8 @@ Queue-id contract (unchanged from the reference, dataset.py:173):
 
 from __future__ import annotations
 
+import collections
 import concurrent.futures as cf
-import queue as _queue
 import threading
 import time
 from typing import Any, List, Optional
@@ -38,6 +38,87 @@ class Empty(Exception):
 
 class Full(Exception):
     """Raised by non-blocking puts on a full queue (reference: multiqueue.py:17-18)."""
+
+
+class BoundedFifo:
+    """Bounded FIFO with atomic all-or-nothing batch operations.
+
+    Owned implementation (deque + two Conditions on one lock) rather than
+    ``queue.Queue`` so the batch ops don't have to reach into stdlib
+    internals. ``maxsize=0`` means unbounded. Raises this module's
+    :class:`Empty`/:class:`Full`.
+    """
+
+    __slots__ = ("_maxsize", "_items", "_mutex", "_not_empty", "_not_full")
+
+    def __init__(self, maxsize: int = 0):
+        self._maxsize = maxsize
+        self._items: collections.deque = collections.deque()
+        self._mutex = threading.Lock()
+        self._not_empty = threading.Condition(self._mutex)
+        self._not_full = threading.Condition(self._mutex)
+
+    def qsize(self) -> int:
+        with self._mutex:
+            return len(self._items)
+
+    def _has_room(self, n: int = 1) -> bool:
+        return not self._maxsize or len(self._items) + n <= self._maxsize
+
+    def put(self, item: Any, block: bool = True,
+            timeout: Optional[float] = None) -> None:
+        with self._not_full:
+            if not self._has_room():
+                if not block:
+                    raise Full("queue is full")
+                deadline = (None if timeout is None
+                            else time.monotonic() + timeout)
+                while not self._has_room():
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        raise Full("queue is full")
+                    self._not_full.wait(remaining)
+            self._items.append(item)
+            self._not_empty.notify()
+
+    def get(self, block: bool = True,
+            timeout: Optional[float] = None) -> Any:
+        with self._not_empty:
+            if not self._items:
+                if not block:
+                    raise Empty("queue is empty")
+                deadline = (None if timeout is None
+                            else time.monotonic() + timeout)
+                while not self._items:
+                    remaining = (None if deadline is None
+                                 else deadline - time.monotonic())
+                    if remaining is not None and remaining <= 0:
+                        raise Empty("queue is empty")
+                    self._not_empty.wait(remaining)
+            item = self._items.popleft()
+            self._not_full.notify()
+            return item
+
+    def put_batch_atomic(self, items: List[Any]) -> None:
+        """Enqueue all of ``items`` or none (non-blocking)."""
+        with self._mutex:
+            if not self._has_room(len(items)):
+                raise Full(
+                    f"cannot accept {len(items)} items "
+                    f"(capacity {self._maxsize}, size {len(self._items)})")
+            self._items.extend(items)
+            self._not_empty.notify_all()
+
+    def get_batch_atomic(self, num_items: int) -> List[Any]:
+        """Dequeue exactly ``num_items`` or nothing (non-blocking)."""
+        with self._mutex:
+            if len(self._items) < num_items:
+                raise Empty(
+                    f"queue has {len(self._items)} items, need {num_items}")
+            out = [self._items.popleft() for _ in range(num_items)]
+            self._not_full.notify_all()
+            return out
 
 
 # Process-local named-queue registry (stands in for Ray's named actors).
@@ -81,8 +162,8 @@ class MultiQueue:
             raise ValueError(f"num_queues must be >= 1, got {num_queues}")
         self._num_queues = num_queues
         self._maxsize = maxsize
-        self._queues: List[_queue.Queue] = [
-            _queue.Queue(maxsize=maxsize) for _ in range(num_queues)
+        self._queues: List[BoundedFifo] = [
+            BoundedFifo(maxsize=maxsize) for _ in range(num_queues)
         ]
         self._name = name
         self._shutdown_event = threading.Event()
@@ -122,7 +203,7 @@ class MultiQueue:
         self._check_open()
         try:
             self._queues[queue_index].put(item, block=block, timeout=timeout)
-        except _queue.Full:
+        except Full:
             raise Full(f"queue {queue_index} is full")
 
     def put_nowait(self, queue_index: int, item: Any) -> None:
@@ -139,15 +220,10 @@ class MultiQueue:
         """All-or-nothing non-blocking batch put, atomic under concurrent
         producers (reference: multiqueue.py:374-381)."""
         self._check_open()
-        q = self._queues[queue_index]
-        with q.mutex:
-            if self._maxsize and len(items) > self._maxsize - q._qsize():
-                raise Full(
-                    f"queue {queue_index} cannot accept {len(items)} items "
-                    f"(capacity {self._maxsize}, size {q._qsize()})")
-            q.queue.extend(items)
-            q.unfinished_tasks += len(items)
-            q.not_empty.notify_all()
+        try:
+            self._queues[queue_index].put_batch_atomic(items)
+        except Full as e:
+            raise Full(f"queue {queue_index}: {e}")
 
     def _submit_async(self, fn, *args) -> cf.Future:
         fut = self._async_pool.submit(fn, *args)
@@ -169,7 +245,7 @@ class MultiQueue:
         """Pop one item (reference: multiqueue.py:185-214)."""
         try:
             return self._queues[queue_index].get(block=block, timeout=timeout)
-        except _queue.Empty:
+        except Empty:
             raise Empty(f"queue {queue_index} is empty")
 
     def get_nowait(self, queue_index: int) -> Any:
@@ -179,15 +255,10 @@ class MultiQueue:
         """Pop exactly ``num_items`` without blocking or raise Empty
         (all-or-nothing, atomic under concurrent consumers,
         reference: multiqueue.py:270-283,383-390)."""
-        q = self._queues[queue_index]
-        with q.mutex:
-            if q._qsize() < num_items:
-                raise Empty(
-                    f"queue {queue_index} has {q._qsize()} items, "
-                    f"need {num_items}")
-            items = [q.queue.popleft() for _ in range(num_items)]
-            q.not_full.notify_all()
-        return items
+        try:
+            return self._queues[queue_index].get_batch_atomic(num_items)
+        except Empty as e:
+            raise Empty(f"queue {queue_index}: {e}")
 
     def get_async(self, queue_index: int) -> cf.Future:
         """Async blocking get; resolves with the item."""

@@ -94,7 +94,7 @@ def _pallas_gather_impl(table: jax.Array, indices: jax.Array,
         in_specs=[
             # The table never enters VMEM wholesale; rows are DMA'd on
             # demand straight out of HBM.
-            pl.BlockSpec(memory_space=pltpu.ANY),
+            pl.BlockSpec(memory_space=pl.ANY),
         ],
         out_specs=pl.BlockSpec((_GATHER_BLOCK, embed_dim),
                                lambda i, idx_ref: (i, 0)),

@@ -41,6 +41,7 @@ import pyarrow.parquet as pq
 from ray_shuffling_data_loader_tpu import executor as ex
 from ray_shuffling_data_loader_tpu import stats as stats_mod
 from ray_shuffling_data_loader_tpu.ops import partition as ops
+from ray_shuffling_data_loader_tpu.utils import fileio
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
 
@@ -232,7 +233,10 @@ def shuffle_map(filename: str,
     with trace_span(f"shuffle_map e{epoch} f{file_index}"):
         table = file_cache.get(filename) if file_cache is not None else None
         if table is None:
-            table = pq.read_table(filename)
+            # Local path or remote URI (gs://, s3://, ... — the reference
+            # reads via smart_open, reference: shuffle.py:7,208); the cache
+            # above keys on the full URI string either way.
+            table = fileio.read_parquet(filename)
             if map_transform is not None:
                 table = map_transform(table)
             if file_cache is not None:
@@ -544,10 +548,16 @@ def shuffle_with_stats(
         max_concurrent_epochs: int = 2,
         seed: int = 0,
         num_workers: Optional[int] = None,
-        utilization_sample_period: float = 5.0
+        utilization_sample_period: float = 5.0,
+        map_transform: Optional[MapTransform] = None,
+        file_cache: Union[FileTableCache, None, str] = "auto",
+        reduce_transform: Optional[ReduceTransform] = None,
+        task_retries: int = 0
 ) -> Tuple[stats_mod.TrialStats, List]:
     """Shuffle plus a concurrent memory-utilization sampler thread
-    (reference: shuffle.py:21-55)."""
+    (reference: shuffle.py:21-55). Forwards the workload hooks
+    (map/reduce transforms, file cache, retries) so the stats-collecting
+    benchmark path can measure e.g. the decode-in-reducer ImageNet config."""
     store_stats: List = []
     done_event = stats_mod.start_store_stats_sampler(
         store_stats, sample_period_s=utilization_sample_period)
@@ -555,7 +565,11 @@ def shuffle_with_stats(
         trial_stats = shuffle(filenames, batch_consumer, num_epochs,
                               num_reducers, num_trainers,
                               max_concurrent_epochs, seed=seed,
-                              num_workers=num_workers, collect_stats=True)
+                              num_workers=num_workers, collect_stats=True,
+                              map_transform=map_transform,
+                              file_cache=file_cache,
+                              reduce_transform=reduce_transform,
+                              task_retries=task_retries)
     finally:
         done_event.set()
     return trial_stats, store_stats
@@ -568,12 +582,19 @@ def shuffle_no_stats(filenames: Sequence[str],
                      num_trainers: int,
                      max_concurrent_epochs: int = 2,
                      seed: int = 0,
-                     num_workers: Optional[int] = None
+                     num_workers: Optional[int] = None,
+                     map_transform: Optional[MapTransform] = None,
+                     file_cache: Union[FileTableCache, None, str] = "auto",
+                     reduce_transform: Optional[ReduceTransform] = None,
+                     task_retries: int = 0
                      ) -> Tuple[float, List]:
     """Duration-only variant (reference: shuffle.py:58-76)."""
     duration = shuffle(filenames, batch_consumer, num_epochs, num_reducers,
                        num_trainers, max_concurrent_epochs, seed=seed,
-                       num_workers=num_workers, collect_stats=False)
+                       num_workers=num_workers, collect_stats=False,
+                       map_transform=map_transform, file_cache=file_cache,
+                       reduce_transform=reduce_transform,
+                       task_retries=task_retries)
     return duration, []
 
 

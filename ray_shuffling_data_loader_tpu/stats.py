@@ -308,6 +308,10 @@ class BatchWaitStats:
     def record(self, wait_s: float) -> None:
         self.wait_times.append(wait_s)
 
+    def reset(self) -> None:
+        """Drop recorded waits (e.g. to exclude warm-up/compile epochs)."""
+        self.wait_times.clear()
+
     def summary(self) -> Dict[str, float]:
         if not self.wait_times:
             return {"mean": 0.0, "std": 0.0, "max": 0.0, "min": 0.0,

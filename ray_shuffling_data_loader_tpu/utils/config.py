@@ -2,13 +2,11 @@
 
 The reference exposes configuration as constructor kwargs plus module
 constants (reference: ray_shuffling_data_loader/dataset.py:11-12,75-86).
-We keep the kwargs surface and add a small dataclass so programmatic
-configuration is explicit and testable.
+We keep the same kwargs surface; the module constants live here.
 """
 
 from __future__ import annotations
 
-import dataclasses
 import os
 from typing import Optional
 
@@ -30,34 +28,3 @@ def default_num_reducers(num_trainers: int, num_cpus: Optional[int] = None) -> i
     if num_cpus is None:
         num_cpus = os.cpu_count() or 1
     return max(1, int(num_trainers * num_cpus * REDUCER_HOST_CORE_SHARE))
-
-
-@dataclasses.dataclass(frozen=True)
-class ShuffleConfig:
-    """Static configuration for a multi-epoch shuffle.
-
-    Mirrors the kwargs of the reference's ``shuffle()`` entrypoint
-    (reference: shuffle.py:79-85) plus a deterministic ``seed`` (the
-    reference uses unseeded np.random — see SURVEY.md §5 — so its epochs
-    are not reproducible; ours are).
-    """
-
-    num_epochs: int
-    num_reducers: int
-    num_trainers: int
-    max_concurrent_epochs: int = DEFAULT_MAX_CONCURRENT_EPOCHS
-    seed: int = 0
-    # Number of worker threads for map/reduce tasks; None = os.cpu_count().
-    num_workers: Optional[int] = None
-    collect_stats: bool = True
-
-    def __post_init__(self) -> None:
-        if self.num_epochs < 1:
-            raise ValueError(f"num_epochs must be >= 1, got {self.num_epochs}")
-        if self.num_reducers < 1:
-            raise ValueError(f"num_reducers must be >= 1, got {self.num_reducers}")
-        if self.num_trainers < 1:
-            raise ValueError(f"num_trainers must be >= 1, got {self.num_trainers}")
-        if self.max_concurrent_epochs < 1:
-            raise ValueError(
-                f"max_concurrent_epochs must be >= 1, got {self.max_concurrent_epochs}")

@@ -145,9 +145,21 @@ def test_prefetch_pipeline_error_propagates(tmp_path):
         list(ds)
 
 
+def _assert_no_prefetch_thread(before_count):
+    import threading
+    import time
+    deadline = 50
+    while threading.active_count() > before_count + 2 and deadline:
+        time.sleep(0.1)
+        deadline -= 1
+    extra = [t.name for t in threading.enumerate()
+             if t.name.startswith("rsdl-jax-prefetch")]
+    assert not extra, extra
+
+
 def test_early_abandon_releases_producer(tmp_path):
-    """Breaking out of iteration mid-epoch must not leak a blocked
-    prefetch thread (regression)."""
+    """With persistent_prefetch=False, breaking out of iteration mid-epoch
+    must not leak a blocked prefetch thread (regression)."""
     import threading
     filenames = write_files(tmp_path)
     before = threading.active_count()
@@ -155,17 +167,152 @@ def test_early_abandon_releases_producer(tmp_path):
         filenames, num_epochs=1, num_trainers=1, batch_size=16, rank=0,
         feature_columns=["emb_1"], feature_types=[np.int32],
         label_column="labels", num_reducers=2, seed=0,
-        queue_name="jax-abandon", prefetch_size=1)
+        queue_name="jax-abandon", prefetch_size=1,
+        persistent_prefetch=False)
     ds.set_epoch(0)
     it = iter(ds)
     next(it)
     it.close()  # abandon mid-epoch
-    # Give the producer a moment to notice and exit.
-    deadline = 50
-    while threading.active_count() > before + 2 and deadline:
-        import time
-        time.sleep(0.1)
-        deadline -= 1
-    extra = [t.name for t in threading.enumerate()
-             if t.name.startswith("rsdl-jax-prefetch")]
-    assert not extra, extra
+    _assert_no_prefetch_thread(before)
+
+
+def test_persistent_close_releases_producer(tmp_path):
+    """With persistent prefetch (the default) the producer survives
+    mid-epoch abandonment by design; close() must release it, and
+    iterating after close() raises instead of replaying epochs."""
+    import threading
+    filenames = write_files(tmp_path)
+    before = threading.active_count()
+    ds = jd.JaxShufflingDataset(
+        filenames, num_epochs=2, num_trainers=1, batch_size=16, rank=0,
+        feature_columns=["emb_1"], feature_types=[np.int32],
+        label_column="labels", num_reducers=2, seed=0,
+        queue_name="jax-abandon-p", prefetch_size=1)
+    ds.set_epoch(0)
+    it = iter(ds)
+    next(it)
+    it.close()  # abandon mid-epoch: producer keeps running
+    ds.close()
+    _assert_no_prefetch_thread(before)
+    ds.close()  # idempotent
+    ds.set_epoch(1)
+    with pytest.raises(RuntimeError, match="closed"):
+        next(iter(ds))
+
+
+# -- persistent-prefetch regression tests ----------------------------------
+
+def _make_ds(tmp_path, qname, **kw):
+    filenames = write_files(tmp_path)
+    kw.setdefault("num_epochs", 3)
+    return jd.JaxShufflingDataset(
+        filenames, num_trainers=1, batch_size=16, rank=0,
+        feature_columns=["emb_1"], feature_types=[np.int32],
+        label_column="labels", num_reducers=2, seed=0,
+        queue_name=qname, **kw)
+
+
+def test_persistent_sequential_epochs_yield_all_batches(tmp_path):
+    ds = _make_ds(tmp_path, "jax-pp-seq", num_epochs=3)
+    for epoch in range(3):
+        ds.set_epoch(epoch)
+        batches = list(ds)
+        assert len(batches) == 256 // 16, epoch
+    ds.close()
+
+
+def test_persistent_out_of_order_epoch_raises(tmp_path):
+    ds = _make_ds(tmp_path, "jax-pp-ooo")
+    ds.set_epoch(0)
+    list(ds)
+    with pytest.raises(ValueError, match="sequential"):
+        ds.set_epoch(2)
+    ds.close()
+
+
+def test_persistent_abandon_then_continue(tmp_path):
+    """Mid-epoch abandonment counts the epoch as consumed; the next
+    sequential set_epoch works and yields only the NEXT epoch's batches."""
+    ds = _make_ds(tmp_path, "jax-pp-abandon", num_epochs=2)
+    ds.set_epoch(0)
+    it = iter(ds)
+    next(it)
+    next(it)
+    it.close()  # early stop after 2 of 16 batches
+    ds.set_epoch(1)
+    batches = list(ds)
+    assert len(batches) == 256 // 16
+    ds.close()
+
+
+def test_persistent_skip_before_producer_starts(tmp_path):
+    ds = _make_ds(tmp_path, "jax-pp-skip-pre", num_epochs=1)
+    ds.set_epoch(0, skip_batches=5)  # producer not started yet
+    batches = list(ds)
+    assert len(batches) == 256 // 16 - 5
+    ds.close()
+
+
+def test_persistent_skip_after_producer_started(tmp_path):
+    ds = _make_ds(tmp_path, "jax-pp-skip-post", num_epochs=2)
+    ds.set_epoch(0)
+    list(ds)
+    # By now the producer has (likely) already entered epoch 1; either way
+    # the skip must drop exactly 3 batches of epoch 1.
+    ds.set_epoch(1, skip_batches=3)
+    batches = list(ds)
+    assert len(batches) == 256 // 16 - 3
+    ds.close()
+
+
+def test_persistent_repeated_set_epoch_does_not_double_skip(tmp_path):
+    import time
+    ds = _make_ds(tmp_path, "jax-pp-skip-twice", num_epochs=1)
+    ds.set_epoch(0, skip_batches=4)
+    # Let the producer start epoch 0 and apply the Arrow-level skip.
+    it = iter(ds)
+    first = next(it)
+    it.close()
+    ds2 = _make_ds(tmp_path, "jax-pp-skip-twice2", num_epochs=1)
+    ds2.set_epoch(0, skip_batches=4)
+    time.sleep(0.1)
+    ds2.set_epoch(0, skip_batches=4)  # same epoch, same skip: no double drop
+    batches = list(ds2)
+    assert len(batches) == 256 // 16 - 4
+    ds2.close()
+    ds.close()
+
+
+def test_persistent_oversized_skip_does_not_eat_next_epoch(tmp_path):
+    """skip_batches >= batches-in-epoch must leave the NEXT epoch intact."""
+    ds = _make_ds(tmp_path, "jax-pp-skip-big", num_epochs=2)
+    ds.set_epoch(0)
+    it = iter(ds)
+    next(it)  # ensure the producer entered epoch 0 (consumer-side skip path)
+    it.close()
+    # Epoch 1 may or may not have been entered yet; drive the consumer-side
+    # path deterministically by iterating one batch first.
+    ds.set_epoch(1, skip_batches=10_000)
+    batches = list(ds)
+    assert batches == []
+    # A skip larger than the epoch must not leak into any later iteration.
+    assert ds._consumer_skip == 0
+    ds.close()
+
+
+def test_persistent_epoch_rollover_prefetches_ahead(tmp_path):
+    """The point of the persistent producer: while the consumer sits
+    between epochs, batches of the next epoch are already buffered."""
+    import time
+    ds = _make_ds(tmp_path, "jax-pp-rollover", num_epochs=2,
+                  prefetch_size=4)
+    ds.set_epoch(0)
+    list(ds)
+    # Producer should roll into epoch 1 without any consumer action.
+    deadline = time.monotonic() + 10
+    while ds._out.qsize() == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert ds._out.qsize() > 0, "producer did not prefetch across the epoch boundary"
+    ds.set_epoch(1)
+    assert len(list(ds)) == 256 // 16
+    ds.close()

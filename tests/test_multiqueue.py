@@ -192,3 +192,47 @@ def test_shutdown_graceful_waits_for_async():
     q.shutdown(grace_period_s=5.0)
     assert fut.done() and fut.exception() is None
     assert q.get(0) == "x"
+
+
+def test_bounded_fifo_direct():
+    """Direct unit tests of the owned BoundedFifo (timeouts + atomic ops)."""
+    import time
+    f = mq.BoundedFifo(maxsize=2)
+    f.put(1)
+    f.put(2)
+    with pytest.raises(mq.Full):
+        f.put(3, block=False)
+    start = time.monotonic()
+    with pytest.raises(mq.Full):
+        f.put(3, timeout=0.05)
+    assert time.monotonic() - start >= 0.04
+    assert f.get() == 1
+    f.put_batch_atomic([3])
+    with pytest.raises(mq.Full):
+        f.put_batch_atomic([4, 5])
+    assert f.get_batch_atomic(2) == [2, 3]
+    with pytest.raises(mq.Empty):
+        f.get_batch_atomic(1)
+    with pytest.raises(mq.Empty):
+        f.get(block=False)
+    start = time.monotonic()
+    with pytest.raises(mq.Empty):
+        f.get(timeout=0.05)
+    assert time.monotonic() - start >= 0.04
+
+
+def test_bounded_fifo_blocking_handoff():
+    import threading
+    f = mq.BoundedFifo(maxsize=1)
+    f.put("a")
+    got = []
+
+    def consumer():
+        got.append(f.get(timeout=5))
+        got.append(f.get(timeout=5))
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    f.put("b", timeout=5)  # unblocks once consumer takes "a"
+    t.join(timeout=5)
+    assert got == ["a", "b"]
