@@ -1,0 +1,189 @@
+"""Micro-benchmark: Pallas flash attention vs naive XLA attention.
+
+Times ops/flash_attention.py fwd and fwd+bwd against the O(S^2)-in-HBM
+XLA attention across sequence lengths on the current backend, plus one
+BERT-MLM train-step throughput line (BASELINE config 4's hot path). This
+is the on-chip evidence for routing models/bert.py through the flash
+kernels; re-run when tuning block sizes or the dispatch threshold.
+
+Usage: python benchmarks/bench_attention.py [--batch 8] [--heads 8]
+           [--head-dim 64] [--seqs 512,1024,2048,4096] [--iters 10]
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import os
+import sys
+import timeit
+
+sys.path.insert(0,
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ray_shuffling_data_loader_tpu.ops import flash_attention as fa
+
+
+def _time_scanned(step_fn, iters=10):
+    """Per-iteration device time of ``step_fn(key) -> pytree``.
+
+    The whole timing loop is ONE jitted ``lax.scan`` over fresh PRNG keys,
+    executed on device in a single dispatch: per-call tunnel RTT (~ms,
+    larger than the kernels being measured) is paid once and amortized
+    away, and fresh keys defeat the tunnel's same-input result cache —
+    repeated identical dispatches otherwise report impossible TF/s.
+    """
+    def scalarize(out):
+        return sum(jnp.sum(leaf.astype(jnp.float32))
+                   for leaf in jax.tree.leaves(out))
+
+    @jax.jit
+    def run(key):
+        def body(carry, k):
+            return carry + scalarize(step_fn(k)), None
+        total, _ = jax.lax.scan(body, jnp.float32(0),
+                                jax.random.split(key, iters))
+        return total
+
+    float(run(jax.random.key(7)))  # compile + warm
+    start = timeit.default_timer()
+    # float() fetches the scalar to host — the only synchronization the
+    # tunneled device honors (block_until_ready can return early there).
+    float(run(jax.random.key(13)))
+    return (timeit.default_timer() - start) / iters
+
+
+def naive_attention(q, k, v):
+    """Reference XLA attention: full (B, H, S, S) scores in HBM."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch", type=int, default=8)
+    parser.add_argument("--heads", type=int, default=8)
+    parser.add_argument("--head-dim", type=int, default=64)
+    parser.add_argument("--seqs", type=str, default="512,1024,2048,4096")
+    parser.add_argument("--iters", type=int, default=10)
+    parser.add_argument("--cpu", action="store_true",
+                        help="pin the CPU backend (smoke runs; the site "
+                             "plugin ignores JAX_PLATFORMS env)")
+    parser.add_argument("--skip-bert", action="store_true")
+    args = parser.parse_args()
+
+    if args.cpu:
+        jax.config.update("jax_platforms", "cpu")
+
+    interpret = jax.default_backend() not in ("tpu", "axon")
+    print(f"backend={jax.default_backend()} interpret={interpret} "
+          f"batch={args.batch} heads={args.heads} head_dim={args.head_dim}")
+    rng = np.random.default_rng(0)
+    b, h, d = args.batch, args.heads, args.head_dim
+
+    def flash(q, k, v):
+        return fa.flash_attention(q, k, v, interpret=interpret)
+
+    for s in map(int, args.seqs.split(",")):
+        shape = (b, h, s, d)
+
+        def gen(key):
+            kq, kk, kv = jax.random.split(key, 3)
+            return (jax.random.normal(kq, shape, jnp.bfloat16),
+                    jax.random.normal(kk, shape, jnp.bfloat16),
+                    jax.random.normal(kv, shape, jnp.bfloat16))
+
+        def fwd_step(key, attn):
+            q, k, v = gen(key)
+            return attn(q, k, v).sum()
+
+        def fb_step(key, attn):
+            q, k, v = gen(key)
+            loss, grads = jax.value_and_grad(
+                lambda q, k, v: attn(q, k, v).sum(), (0, 1, 2))(q, k, v)
+            return loss, jax.tree.map(lambda g: g.sum(), grads)
+
+        naive_f = jax.jit(functools.partial(fwd_step, attn=naive_attention))
+        flash_f = jax.jit(functools.partial(fwd_step, attn=flash))
+        naive_g = jax.jit(functools.partial(fb_step, attn=naive_attention))
+        flash_g = jax.jit(functools.partial(fb_step, attn=flash))
+
+        # FLOPs: 2 matmuls of 2*B*H*S*S*D each (fwd); f+b ~3.5x fwd.
+        flops = 4 * b * h * s * s * d
+        row = [f"S={s:>5}"]
+        try:
+            t_n = _time_scanned(naive_f, iters=args.iters)
+            row.append(f"xla fwd {t_n*1e3:8.2f}ms "
+                       f"{flops/t_n/1e12:6.2f}TF/s")
+        except Exception as e:  # noqa: BLE001 - OOM at long S is the point
+            t_n = None
+            row.append(f"xla fwd FAILED ({type(e).__name__})")
+        t_f = _time_scanned(flash_f, iters=args.iters)
+        row.append(f"flash fwd {t_f*1e3:8.2f}ms {flops/t_f/1e12:6.2f}TF/s")
+        if t_n:
+            row.append(f"speedup {t_n/t_f:5.2f}x")
+        try:
+            t_ng = _time_scanned(naive_g, iters=args.iters)
+            row.append(f"| xla f+b {t_ng*1e3:8.2f}ms")
+        except Exception as e:  # noqa: BLE001
+            t_ng = None
+            row.append(f"| xla f+b FAILED ({type(e).__name__})")
+        t_fg = _time_scanned(flash_g, iters=args.iters)
+        row.append(f"flash f+b {t_fg*1e3:8.2f}ms")
+        if t_ng:
+            row.append(f"speedup {t_ng/t_fg:5.2f}x")
+        print("  ".join(row))
+
+    if args.skip_bert:
+        return
+
+    # BERT-MLM train step (models/bert.py), flash vs inline attention.
+    import optax
+    from ray_shuffling_data_loader_tpu.models import bert
+
+    seq_len = 512
+    cfg = bert.BertConfig(vocab_size=30522, hidden_dim=512, num_layers=4,
+                          num_heads=8, ffn_dim=2048, max_seq_len=seq_len)
+    params = bert.init(cfg, jax.random.key(0))
+    tokens = jnp.asarray(
+        rng.integers(0, cfg.vocab_size, (args.batch, seq_len)), jnp.int32)
+    targets = jnp.where(
+        jnp.asarray(rng.random((args.batch, seq_len))) < 0.15, tokens,
+        bert.IGNORE_ID).astype(jnp.int32)
+    tx = optax.adam(1e-4)
+
+    flash_fn = fa.make_flash_attention_fn()
+
+    for name, attention_fn in (("inline", None), ("flash", flash_fn)):
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state, tokens, targets, _fn=attention_fn):
+            loss, grads = jax.value_and_grad(bert.loss_fn, argnums=1)(
+                cfg, params, tokens, targets, attention_fn=_fn)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        p, o, loss = step(params, opt_state, tokens, targets)
+        float(loss)  # compile + warm (host fetch = real tunnel sync)
+        start = timeit.default_timer()
+        iters = max(3, args.iters // 2)
+        for _ in range(iters):
+            p, o, loss = step(p, o, tokens, targets)
+        # The final loss depends on every prior step's params, so one
+        # scalar fetch synchronizes the whole chain.
+        float(loss)
+        dt = (timeit.default_timer() - start) / iters
+        print(f"bert[{name:6}] S={seq_len} train step {dt*1e3:8.2f}ms  "
+              f"{args.batch*seq_len/dt:,.0f} tokens/s  loss={float(loss):.3f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,148 @@
+"""Distributed-path benchmark: transport MB/s and multi-host shuffle rows/s.
+
+Two measurements on one machine (the reference analog is cross-node plasma
+object transfer, reference: shuffle.py:185-186):
+
+1. TcpTransport point-to-point goodput (16 MB tagged frames over loopback,
+   pool-tracked recv buffers) — the DCN-plane floor for cross-host chunks.
+2. shuffle_distributed rows/s for localhost worlds of 2 and 4 "hosts"
+   (threads, each with its own transport + executor, exchanging real Arrow
+   IPC chunks), vs the single-host engine on the same corpus.
+
+Usage: python benchmarks/bench_distributed.py [--rows 200000] [--files 8]
+           [--epochs 2] [--payload-mb 16] [--sends 8]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import threading
+import timeit
+
+sys.path.insert(0,
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from ray_shuffling_data_loader_tpu import data_generation as datagen
+from ray_shuffling_data_loader_tpu import executor as ex
+from ray_shuffling_data_loader_tpu.parallel import distributed as dist
+from ray_shuffling_data_loader_tpu.parallel.transport import (
+    create_local_transports)
+
+
+def bench_transport(payload_mb: int, sends: int) -> float:
+    """One-way tagged-frame goodput host0 -> host1 over loopback."""
+    world = create_local_transports(2)
+    payload = np.random.default_rng(0).integers(
+        0, 256, payload_mb << 20, dtype=np.uint8).tobytes()
+    try:
+        # Warm-up round trip.
+        world[0].send(1, (0, 0, 0), payload)
+        world[1].recv(0, (0, 0, 0))
+
+        done = threading.Event()
+
+        def receiver():
+            for i in range(sends):
+                world[1].recv(0, (1, 0, i))
+            done.set()
+
+        t = threading.Thread(target=receiver)
+        start = timeit.default_timer()
+        t.start()
+        for i in range(sends):
+            world[0].send(1, (1, 0, i), payload)
+        done.wait()
+        duration = timeit.default_timer() - start
+        t.join()
+        return sends * payload_mb / duration
+    finally:
+        for t_ in world:
+            t_.close()
+
+
+def bench_distributed_shuffle(filenames, num_epochs: int, world_size: int,
+                              num_reducers: int) -> float:
+    """Aggregate rows/s of a localhost world running shuffle_distributed."""
+    transports = create_local_transports(world_size)
+    consumed = [0] * world_size
+
+    def consume_all(host):
+        def batch_consumer(rank, epoch, refs):
+            if refs is None:
+                return
+            for ref in refs:
+                consumed[host] += ref.result().num_rows
+        return batch_consumer
+
+    def run_host(host):
+        dist.shuffle_distributed(
+            filenames, consume_all(host), num_epochs, num_reducers,
+            transports[host], max_concurrent_epochs=2, seed=0,
+            file_cache=None, num_workers=2)
+
+    threads = [threading.Thread(target=run_host, args=(h,))
+               for h in range(world_size)]
+    start = timeit.default_timer()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    duration = timeit.default_timer() - start
+    for t_ in transports:
+        t_.close()
+    return sum(consumed) / duration
+
+
+def main() -> None:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--rows", type=int, default=200_000)
+    parser.add_argument("--files", type=int, default=8)
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--payload-mb", type=int, default=16)
+    parser.add_argument("--sends", type=int, default=8)
+    parser.add_argument("--data-dir", type=str,
+                        default="/tmp/rsdl_dist_bench")
+    args = parser.parse_args()
+
+    mbps = bench_transport(args.payload_mb, args.sends)
+    print(f"transport p2p goodput: {mbps:,.0f} MB/s "
+          f"({args.sends} x {args.payload_mb} MB frames, loopback)")
+
+    filenames, _ = datagen.generate_data(
+        args.rows, args.files, num_row_groups_per_file=2,
+        max_row_group_skew=0.0, data_dir=args.data_dir, seed=0)
+
+    for world_size in (1, 2, 4):
+        if world_size == 1:
+            # Single-host engine baseline on the same corpus.
+            import importlib
+            sh = importlib.import_module(
+                "ray_shuffling_data_loader_tpu.shuffle")
+            consumed = [0]
+
+            def batch_consumer(rank, epoch, refs):
+                if refs is None:
+                    return
+                for ref in refs:
+                    consumed[0] += ref.result().num_rows
+
+            start = timeit.default_timer()
+            sh.shuffle(filenames, batch_consumer, args.epochs,
+                       num_reducers=4, num_trainers=1,
+                       max_concurrent_epochs=2, seed=0,
+                       collect_stats=False, file_cache=None)
+            rows_per_s = consumed[0] / (timeit.default_timer() - start)
+        else:
+            rows_per_s = bench_distributed_shuffle(
+                filenames, args.epochs, world_size,
+                num_reducers=2 * world_size)
+        print(f"world={world_size}: {rows_per_s:,.0f} rows/s "
+              f"({args.rows} rows x {args.epochs} epochs)")
+
+
+if __name__ == "__main__":
+    main()

@@ -226,6 +226,9 @@ def main(argv=None):
     print(f"[rank {rank}] DONE: {waits['count']} batches, "
           f"total stall {waits['total']:.2f}s "
           f"(mean {waits['mean'] * 1e3:.1f}ms/batch)")
+    # Release the persistent prefetch producer (no-op if it already exited
+    # after the final epoch).
+    ds.close()
     if transport is not None:
         transport.close()
 

@@ -136,7 +136,7 @@ def generate_data(num_rows: int, num_files: int,
                   ) -> Tuple[List[str], int]:
     """Parallel generation on the host pool (reference: data_generation.py:14-28)."""
     assert max_row_group_skew == 0.0, "row-group skew is not implemented"
-    os.makedirs(data_dir, exist_ok=True)
+    fileio.makedirs(data_dir)
     with ex.Executor(num_workers=num_workers,
                      thread_name_prefix="rsdl-datagen") as pool:
         refs = [
@@ -157,7 +157,7 @@ def generate_data_local(num_rows: int, num_files: int,
                         seed: int = 0) -> Tuple[List[str], int]:
     """Sequential variant (reference: data_generation.py:31-45)."""
     assert max_row_group_skew == 0.0, "row-group skew is not implemented"
-    os.makedirs(data_dir, exist_ok=True)
+    fileio.makedirs(data_dir)
     results = [
         generate_file(file_index, start, n, num_row_groups_per_file,
                       data_dir, seed)

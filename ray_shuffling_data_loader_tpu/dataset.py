@@ -121,7 +121,8 @@ def create_batch_queue_and_shuffle(
         map_transform=None,
         reduce_transform=None,
         task_retries: int = 0,
-        file_cache="auto"):
+        file_cache="auto",
+        max_inflight_bytes: Optional[int] = None):
     """Driver-mode helper: create the queue and start the shuffle before any
     trainer exists, so every rank can be a pure consumer
     (reference: dataset.py:17-51)."""
@@ -151,6 +152,7 @@ def create_batch_queue_and_shuffle(
         reduce_transform=reduce_transform,
         task_retries=task_retries,
         file_cache=file_cache,
+        max_inflight_bytes=max_inflight_bytes,
         on_failure=make_failure_broadcaster(batch_queue,
                                             num_epochs * num_trainers))
     return batch_queue, shuffle_result
@@ -191,7 +193,8 @@ class ShufflingDataset:
                  map_transform=None,
                  reduce_transform=None,
                  task_retries: int = 0,
-                 file_cache="auto"):
+                 file_cache="auto",
+                 max_inflight_bytes: Optional[int] = None):
         if num_reducers is None:
             num_reducers = default_num_reducers(num_trainers)
         self._batch_size = batch_size
@@ -209,7 +212,8 @@ class ShufflingDataset:
                         map_transform=map_transform,
                         reduce_transform=reduce_transform,
                         task_retries=task_retries,
-                        file_cache=file_cache))
+                        file_cache=file_cache,
+                        max_inflight_bytes=max_inflight_bytes))
                 self._owns_queue = True
             else:
                 self._batch_queue = mq.MultiQueue(

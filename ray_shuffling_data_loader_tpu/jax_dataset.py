@@ -197,6 +197,137 @@ def convert_to_arrays(table: pa.Table,
     return features, label
 
 
+class _BatchConverter:
+    """Self-contained Arrow-batch -> device-batch pipeline stage.
+
+    Holds ONLY the column spec and transfer config — deliberately no
+    reference to the dataset wrapper, so the persistent producer thread
+    (which runs this) never pins the wrapper and a dropped
+    ``JaxShufflingDataset`` can be garbage-collected (its finalizer then
+    stops the producer).
+    """
+
+    def __init__(self, feature_columns, feature_shapes, feature_types,
+                 label_column, label_shape, label_type, stack_features,
+                 mesh, data_axis, device_put):
+        self._feature_columns = feature_columns
+        self._feature_shapes = feature_shapes
+        self._feature_types = feature_types
+        self._label_column = label_column
+        self._label_shape = label_shape
+        self._label_type = label_type
+        self._stack_features = stack_features
+        self._mesh = mesh
+        self._data_axis = data_axis
+        self._device_put = device_put
+        self._device_concat = None  # jitted column concat, built lazily
+
+    def _sharding(self, ndim: int):
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        if self._mesh is None:
+            return None
+        return NamedSharding(
+            self._mesh, P(self._data_axis, *([None] * (ndim - 1))))
+
+    def convert(self, table: pa.Table):
+        return convert_to_arrays(
+            table, self._feature_columns, self._feature_shapes,
+            self._feature_types, self._label_column, self._label_shape,
+            self._label_type)
+
+    def transfer(self, arrays_label):
+        """Host arrays -> device arrays (sharded if a mesh was given).
+
+        With ``stack_features``, per-column host arrays are transferred
+        individually (zero-copy views of the Arrow buffers) and stacked by
+        one jitted ``jnp.concatenate`` on device — the host-side strided
+        interleave this replaces was a top host cost of the ingest path.
+        """
+        import jax
+        features, label = arrays_label
+        if not self._device_put:
+            if self._stack_features:
+                features = (features[0] if len(features) == 1
+                            else np.concatenate(features, axis=1))
+            return features, label
+        # ONE device_put for the whole batch pytree: the runtime batches
+        # the per-column copies into a single transfer (through the PJRT
+        # client once, not once per column — on a tunneled device that is
+        # the difference between 1 and 20 round-trips per batch).
+        if self._mesh is None:
+            out_features, out_label = jax.device_put((features, label))
+        else:
+            out_features, out_label = jax.device_put(
+                (features, label),
+                ([self._sharding(a.ndim) for a in features],
+                 self._sharding(label.ndim)))
+        if self._stack_features:
+            if len(out_features) == 1:
+                out_features = out_features[0]
+            else:
+                if self._device_concat is None:
+                    import jax.numpy as jnp
+                    self._device_concat = jax.jit(
+                        lambda cols: jnp.concatenate(cols, axis=1))
+                out_features = self._device_concat(out_features)
+        return out_features, out_label
+
+
+def _persistent_producer(dataset: ShufflingDataset,
+                         converter: _BatchConverter,
+                         out: "_queue.Queue",
+                         stop: threading.Event,
+                         lock: threading.Lock,
+                         pending_skips: dict,
+                         started_epochs: set) -> None:
+    """Producer loop for ALL epochs (persistent_prefetch).
+
+    Module-level on purpose: it references the underlying ShufflingDataset
+    and small shared state objects but NOT the JaxShufflingDataset wrapper,
+    so an abandoned wrapper is collectable and its weakref finalizer (which
+    sets ``stop`` and drains ``out``) releases this thread even when the
+    consumer never called close().
+    """
+
+    def put(item) -> bool:
+        while not stop.is_set():
+            try:
+                out.put(item, timeout=0.1)
+                return True
+            except _queue.Full:
+                continue
+        return False
+
+    try:
+        for epoch in range(dataset.start_epoch, dataset.num_epochs):
+            with lock:
+                started_epochs.add(epoch)
+                skip = pending_skips.pop(epoch, 0)
+            dataset.set_epoch(epoch, skip_batches=skip)
+            for table in dataset:
+                with trace_span("batch_convert"):
+                    arrays = converter.convert(table)
+                with trace_span("batch_transfer"):
+                    batch = converter.transfer(arrays)
+                if not put(("batch", epoch, batch)):
+                    return
+            if not put(("end", epoch, None)):
+                return
+    except BaseException as e:  # noqa: BLE001 - forwarded to consumer
+        put(e)
+
+
+def _release_producer(stop: threading.Event, out: "_queue.Queue") -> None:
+    """Finalizer for a dropped JaxShufflingDataset: stop the producer and
+    drop its buffered device batches."""
+    stop.set()
+    try:
+        while True:
+            out.get_nowait()
+    except _queue.Empty:
+        pass
+
+
 class JaxShufflingDataset:
     """Shuffled batches as device-resident, optionally mesh-sharded
     ``jax.Array``s, with prefetch double-buffering.
@@ -243,6 +374,8 @@ class JaxShufflingDataset:
             only): a ``shuffle.FileTableCache``, ``"auto"`` (budgeted from
             host RAM), or ``None`` to disable cross-epoch caching of
             decoded files.
+        max_inflight_bytes: byte budget for transient shuffle memory
+            (in-flight map + reducer tables); see ``shuffle.shuffle``.
     """
 
     def __init__(self,
@@ -275,7 +408,8 @@ class JaxShufflingDataset:
                  cast_at_map: bool = True,
                  reduce_transform=None,
                  persistent_prefetch: bool = True,
-                 file_cache="auto"):
+                 file_cache="auto",
+                 max_inflight_bytes: Optional[int] = None):
         (self._feature_columns, self._feature_shapes, self._feature_types,
          self._label_column, self._label_shape, self._label_type) = (
              _normalize_jax_data_spec(feature_columns, feature_shapes,
@@ -305,12 +439,16 @@ class JaxShufflingDataset:
             max_batch_queue_size=max_batch_queue_size, seed=seed,
             num_workers=num_workers, queue_name=queue_name,
             start_epoch=start_epoch, map_transform=map_transform,
-            reduce_transform=reduce_transform, file_cache=file_cache)
+            reduce_transform=reduce_transform, file_cache=file_cache,
+            max_inflight_bytes=max_inflight_bytes)
         self._mesh = mesh
         self._data_axis = data_axis
         self._prefetch_size = max(1, prefetch_size)
         self._device_put = device_put
-        self._device_concat = None  # jitted column concat, built lazily
+        self._converter = _BatchConverter(
+            self._feature_columns, self._feature_shapes, self._feature_types,
+            self._label_column, self._label_shape, self._label_type,
+            stack_features, mesh, data_axis, device_put)
         self.batch_wait_stats = BatchWaitStats()
         # Persistent-prefetch state (one producer thread for ALL epochs).
         self._persistent = persistent_prefetch
@@ -325,6 +463,7 @@ class JaxShufflingDataset:
         self._next_epoch = self._dataset.start_epoch  # next to consume
         self._epoch_set = False          # set_epoch called since last iter
         self._closed = False             # close() is terminal
+        self._active_gen = None          # live persistent-epoch generator
 
     def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
         if not self._persistent:
@@ -332,6 +471,18 @@ class JaxShufflingDataset:
             return
         if skip_batches < 0:
             raise ValueError(f"skip_batches must be >= 0, got {skip_batches}")
+        if self._active_gen is not None:
+            # Finalize a previous epoch's iterator NOW (a consumer that
+            # broke out mid-epoch and moved on without close()-ing the
+            # iterator must not depend on GC timing): closing it runs the
+            # generator's finally, which marks that epoch consumed.
+            try:
+                self._active_gen.close()
+            except ValueError:
+                raise RuntimeError(
+                    "set_epoch called while another thread is iterating "
+                    "this dataset")
+            self._active_gen = None
         if epoch != self._next_epoch:
             raise ValueError(
                 f"persistent_prefetch requires sequential epochs: expected "
@@ -371,55 +522,11 @@ class JaxShufflingDataset:
     def num_epochs(self) -> int:
         return self._dataset.num_epochs
 
-    def _sharding(self, ndim: int):
-        from jax.sharding import NamedSharding, PartitionSpec as P
-        if self._mesh is None:
-            return None
-        return NamedSharding(
-            self._mesh, P(self._data_axis, *([None] * (ndim - 1))))
+    def _convert(self, table: pa.Table):
+        return self._converter.convert(table)
 
     def _transfer(self, arrays_label):
-        """Host arrays -> device arrays (sharded if a mesh was given).
-
-        With ``stack_features``, per-column host arrays are transferred
-        individually (zero-copy views of the Arrow buffers) and stacked by
-        one jitted ``jnp.concatenate`` on device — the host-side strided
-        interleave this replaces was a top host cost of the ingest path.
-        """
-        import jax
-        features, label = arrays_label
-        if not self._device_put:
-            if self._stack_features:
-                features = (features[0] if len(features) == 1
-                            else np.concatenate(features, axis=1))
-            return features, label
-        # ONE device_put for the whole batch pytree: the runtime batches
-        # the per-column copies into a single transfer (through the PJRT
-        # client once, not once per column — on a tunneled device that is
-        # the difference between 1 and 20 round-trips per batch).
-        if self._mesh is None:
-            out_features, out_label = jax.device_put((features, label))
-        else:
-            out_features, out_label = jax.device_put(
-                (features, label),
-                ([self._sharding(a.ndim) for a in features],
-                 self._sharding(label.ndim)))
-        if self._stack_features:
-            if len(out_features) == 1:
-                out_features = out_features[0]
-            else:
-                if self._device_concat is None:
-                    import jax.numpy as jnp
-                    self._device_concat = jax.jit(
-                        lambda cols: jnp.concatenate(cols, axis=1))
-                out_features = self._device_concat(out_features)
-        return out_features, out_label
-
-    def _convert(self, table: pa.Table):
-        return convert_to_arrays(
-            table, self._feature_columns, self._feature_shapes,
-            self._feature_types, self._label_column, self._label_shape,
-            self._label_type)
+        return self._converter.transfer(arrays_label)
 
     def __iter__(self) -> Iterator[Tuple[List[Any], Any]]:
         """Yield ``(features, label)`` device batches.
@@ -439,41 +546,12 @@ class JaxShufflingDataset:
             import jax
             jax.local_devices()
         if self._persistent:
-            yield from self._iter_persistent()
-        else:
-            yield from self._iter_single_epoch()
+            gen = self._iter_persistent()
+            self._active_gen = gen
+            return gen
+        return self._iter_single_epoch()
 
     # -- persistent (cross-epoch) producer ---------------------------------
-
-    def _persistent_put(self, item) -> bool:
-        """Bounded put that gives up when the dataset is closed."""
-        while not self._stop.is_set():
-            try:
-                self._out.put(item, timeout=0.1)
-                return True
-            except _queue.Full:
-                continue
-        return False
-
-    def _producer_loop(self) -> None:
-        try:
-            for epoch in range(self._dataset.start_epoch,
-                               self._dataset.num_epochs):
-                with self._lock:
-                    self._started_epochs.add(epoch)
-                    skip = self._pending_skips.pop(epoch, 0)
-                self._dataset.set_epoch(epoch, skip_batches=skip)
-                for table in self._dataset:
-                    with trace_span("batch_convert"):
-                        arrays = self._convert(table)
-                    with trace_span("batch_transfer"):
-                        batch = self._transfer(arrays)
-                    if not self._persistent_put(("batch", epoch, batch)):
-                        return
-                if not self._persistent_put(("end", epoch, None)):
-                    return
-        except BaseException as e:  # noqa: BLE001 - forwarded to consumer
-            self._persistent_put(e)
 
     def _iter_persistent(self) -> Iterator[Tuple[List[Any], Any]]:
         if self._closed:
@@ -489,10 +567,18 @@ class JaxShufflingDataset:
         self._epoch_set = False
         epoch = self._next_epoch
         if self._thread is None:
+            import weakref
             self._out = _queue.Queue(maxsize=self._prefetch_size)
-            self._thread = threading.Thread(target=self._producer_loop,
-                                            daemon=True,
-                                            name="rsdl-jax-prefetch")
+            # The producer references the underlying ShufflingDataset and
+            # converter but NOT self — so a wrapper dropped without close()
+            # is garbage-collected and this finalizer releases the thread
+            # (and its device-resident buffered batches).
+            self._thread = threading.Thread(
+                target=_persistent_producer,
+                args=(self._dataset, self._converter, self._out, self._stop,
+                      self._lock, self._pending_skips, self._started_epochs),
+                daemon=True, name="rsdl-jax-prefetch")
+            weakref.finalize(self, _release_producer, self._stop, self._out)
             self._thread.start()
         try:
             while True:
@@ -542,6 +628,25 @@ class JaxShufflingDataset:
                     self._out.get_nowait()
             except _queue.Empty:
                 pass
+            try:
+                # Wake a consumer blocked in the iterator's get(): the
+                # producer is stopped, so nothing else will.
+                self._out.put_nowait(
+                    RuntimeError("JaxShufflingDataset closed while a "
+                                 "consumer was blocked on a batch"))
+            except _queue.Full:
+                pass
+        if self._active_gen is not None:
+            try:
+                # A suspended iterator resumed after close() would block on
+                # the drained queue; finalize it instead.
+                self._active_gen.close()
+            except ValueError:
+                # Generator currently executing in the consumer thread
+                # (close() from a watchdog): the poison item above will
+                # raise there instead.
+                pass
+            self._active_gen = None
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

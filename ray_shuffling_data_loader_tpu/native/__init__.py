@@ -59,6 +59,8 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.rsdl_fill_random_double.restype = None
     lib.rsdl_buffer_alloc.argtypes = [i64]
     lib.rsdl_buffer_alloc.restype = i64
+    lib.rsdl_buffer_register.argtypes = [i64]
+    lib.rsdl_buffer_register.restype = i64
     lib.rsdl_buffer_data.argtypes = [i64]
     lib.rsdl_buffer_data.restype = ctypes.c_void_p
     lib.rsdl_buffer_size.argtypes = [i64]
@@ -207,7 +209,24 @@ class NativeBufferPool:
 
     Plasma-equivalent role (SURVEY.md §2.3): host-RAM buffers with explicit
     refcounts so the shuffle's memory footprint is observable and bounded.
+    Two kinds of entries share the ledger:
+
+    - ``alloc``: real 64-byte-aligned allocations (transport recv buffers
+      use these — see :func:`alloc_tracked_buffer`).
+    - ``register``: accounting-only entries for bytes owned by an external
+      allocator (Arrow tables — see :func:`account_table`).
     """
+
+    def register(self, size: int) -> int:
+        """Ledger-only entry for externally-allocated bytes."""
+        if size < 0:
+            raise ValueError(f"buffer size must be >= 0, got {size}")
+        lib = _load()
+        assert lib is not None
+        buf_id = lib.rsdl_buffer_register(size)
+        if buf_id == 0:
+            raise MemoryError(f"native buffer register of {size} bytes failed")
+        return buf_id
 
     def alloc(self, size: int) -> int:
         if size < 0:
@@ -255,3 +274,120 @@ class NativeBufferPool:
         lib = _load()
         assert lib is not None
         return lib.rsdl_buffer_count()
+
+
+class PythonBufferLedger:
+    """Pure-Python fallback with NativeBufferPool's accounting API, used
+    when no compiler is present (RSDL_TPU_DISABLE_NATIVE, minimal images)
+    so pipeline memory accounting works everywhere. ``alloc`` entries are
+    backed by numpy arrays."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._entries = {}  # id -> [data_or_None, size, refcount]
+        self._next_id = 1
+        self._bytes = 0
+
+    def _new_entry(self, data, size: int) -> int:
+        with self._lock:
+            buf_id = self._next_id
+            self._next_id += 1
+            self._entries[buf_id] = [data, size, 1]
+            self._bytes += size
+            return buf_id
+
+    def register(self, size: int) -> int:
+        if size < 0:
+            raise ValueError(f"buffer size must be >= 0, got {size}")
+        return self._new_entry(None, size)
+
+    def alloc(self, size: int) -> int:
+        if size < 0:
+            raise ValueError(f"buffer size must be >= 0, got {size}")
+        return self._new_entry(np.empty(size, dtype=np.uint8), size)
+
+    def view(self, buf_id: int) -> np.ndarray:
+        with self._lock:
+            if buf_id not in self._entries:
+                raise KeyError(f"unknown buffer id {buf_id}")
+            data = self._entries[buf_id][0]
+        if data is None:
+            raise KeyError(f"buffer id {buf_id} is accounting-only")
+        return data
+
+    def incref(self, buf_id: int) -> int:
+        with self._lock:
+            if buf_id not in self._entries:
+                raise KeyError(f"unknown buffer id {buf_id}")
+            self._entries[buf_id][2] += 1
+            return self._entries[buf_id][2]
+
+    def decref(self, buf_id: int) -> int:
+        with self._lock:
+            if buf_id not in self._entries:
+                raise KeyError(f"unknown buffer id {buf_id}")
+            entry = self._entries[buf_id]
+            entry[2] -= 1
+            if entry[2] == 0:
+                del self._entries[buf_id]
+                self._bytes -= entry[1]
+            return entry[2]
+
+    def bytes_in_use(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def buffer_count(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+
+_py_ledger: Optional[PythonBufferLedger] = None
+_py_ledger_lock = threading.Lock()
+
+
+def buffer_ledger():
+    """THE process-wide buffer ledger: the native pool when the C++ library
+    is loaded, else the Python fallback. All pipeline memory accounting
+    (file cache, in-flight reducer tables, transport recv buffers) goes
+    through this one object; ``stats.get_memory_stats().pool_bytes``
+    reports its total."""
+    global _py_ledger
+    if available():
+        return NativeBufferPool()
+    with _py_ledger_lock:
+        if _py_ledger is None:
+            _py_ledger = PythonBufferLedger()
+        return _py_ledger
+
+
+def account_table(table) -> None:
+    """Charge an Arrow table's bytes to the ledger for the lifetime of its
+    Python wrapper (released by GC — the wrapper is the handle every
+    pipeline stage passes around, so 'wrapper alive' is 'bytes in flight').
+    """
+    import weakref
+    nbytes = table.nbytes
+    if nbytes <= 0:
+        return
+    ledger = buffer_ledger()
+    buf_id = ledger.register(nbytes)
+    weakref.finalize(table, ledger.decref, buf_id)
+
+
+def alloc_tracked_buffer(size: int) -> np.ndarray:
+    """Pool-allocated uint8 buffer returned as an ndarray; the bytes are
+    returned to the pool when the array (and everything referencing it —
+    memoryviews, Arrow buffers made with pa.py_buffer) is collected."""
+    import weakref
+    ledger = buffer_ledger()
+    if isinstance(ledger, NativeBufferPool):
+        buf_id = ledger.alloc(size)
+        arr = ledger.view(buf_id)
+    else:
+        # Fallback: numpy owns the bytes; the ledger only accounts them
+        # (storing the array in the ledger would keep it alive forever).
+        arr = np.empty(size, dtype=np.uint8)
+        buf_id = ledger.register(size)
+    weakref.finalize(arr, ledger.decref, buf_id)
+    return arr

@@ -228,6 +228,22 @@ int64_t rsdl_buffer_alloc(int64_t size) {
   return id;
 }
 
+// Ledger-only entry: account `size` bytes owned by an EXTERNAL allocator
+// (Arrow tables, fsspec buffers) under the pool's refcount lifetime without
+// allocating. data() reports nullptr for these; decref at zero only drops
+// the ledger entry. This is how the Python layer makes pipeline-wide memory
+// (cache + in-flight reducer outputs + transport buffers) observable
+// through one counter, plasma-store style.
+int64_t rsdl_buffer_register(int64_t size) {
+  if (size < 0) return 0;
+  auto* buf = new Buffer(nullptr, size);
+  std::lock_guard<std::mutex> lock(g_pool_mutex);
+  int64_t id = g_next_id++;
+  g_pool[id] = buf;
+  g_bytes_in_use.fetch_add(size);
+  return id;
+}
+
 void* rsdl_buffer_data(int64_t id) {
   std::lock_guard<std::mutex> lock(g_pool_mutex);
   auto it = g_pool.find(id);

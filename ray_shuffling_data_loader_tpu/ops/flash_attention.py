@@ -286,8 +286,8 @@ def flash_attention(q: jax.Array,
                     k: jax.Array,
                     v: jax.Array,
                     bias: Optional[jax.Array] = None,
-                    block_q: int = 128,
-                    block_k: int = 128,
+                    block_q: int = 512,
+                    block_k: int = 512,
                     interpret: bool = False) -> jax.Array:
     """Exact attention via the Pallas flash kernels.
 
@@ -304,8 +304,8 @@ def flash_attention(q: jax.Array,
     return out
 
 
-def flash_forward(q, k, v, bias=None, block_q: int = 128,
-                  block_k: int = 128, interpret: bool = False):
+def flash_forward(q, k, v, bias=None, block_q: int = 512,
+                  block_k: int = 512, interpret: bool = False):
     """Forward kernels only: returns ``(out, lse)`` with lse
     (B, H, Sq, 1) float32 — the partial-softmax residual ring attention
     needs to merge per-hop results (ops/ring_attention.py)."""
@@ -323,8 +323,8 @@ def _flash_bwd(block_q, block_k, interpret, residuals, do):
                           interpret)
 
 
-def flash_backward(q, k, v, bias, out, lse, do, block_q: int = 128,
-                   block_k: int = 128, interpret: bool = False):
+def flash_backward(q, k, v, bias, out, lse, do, block_q: int = 512,
+                   block_k: int = 512, interpret: bool = False):
     """Backward kernels: ``(dq, dk, dv, dbias)`` from the standard flash
     residuals. ``lse`` may be global (covering MORE keys than ``k``) — the
     ring backward exploits this: with the global logsumexp, the recomputed
@@ -417,8 +417,8 @@ def flash_backward(q, k, v, bias, out, lse, do, block_q: int = 128,
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
-def make_flash_attention_fn(block_q: int = 128,
-                            block_k: int = 128,
+def make_flash_attention_fn(block_q: int = 512,
+                            block_k: int = 512,
                             interpret: Optional[bool] = None):
     """An ``attention_fn(q, k, v, bias)`` closure for models/bert.py.
 

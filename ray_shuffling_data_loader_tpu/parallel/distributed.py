@@ -87,12 +87,17 @@ class ShardPlan:
         for t, group in enumerate(trainer_groups):
             for r in group:
                 self._reducer_host[r] = t // trainers_per_host
+        # file -> owning host, O(1) (resolved once per (file, reducer) pair
+        # per epoch on the reduce hot path).
+        self._file_host = [0] * num_files
+        for h, shard in enumerate(self.file_shards):
+            for f in shard:
+                self._file_host[f] = h
 
     def file_host(self, file_index: int) -> int:
-        for h, shard in enumerate(self.file_shards):
-            if shard and shard[0] <= file_index <= shard[-1]:
-                return h
-        raise ValueError(f"file index {file_index} out of range")
+        if not 0 <= file_index < self.num_files:
+            raise ValueError(f"file index {file_index} out of range")
+        return self._file_host[file_index]
 
     def reducer_host(self, reducer_index: int) -> int:
         return self._reducer_host[reducer_index]

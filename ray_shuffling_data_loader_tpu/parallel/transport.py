@@ -26,7 +26,7 @@ from __future__ import annotations
 import socket
 import struct
 import threading
-from typing import Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
@@ -59,6 +59,30 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return b"".join(chunks) if len(chunks) != 1 else chunks[0]
 
 
+def _recv_payload(sock: socket.socket, n: int):
+    """Read an n-byte payload into a pool-tracked buffer.
+
+    The buffer comes from the native buffer pool (``recv_into``, single
+    copy off the socket — no ``b"".join`` concat pass) and its bytes stay
+    charged to the pipeline ledger until every reference is gone —
+    including zero-copy Arrow tables deserialized over it, which keep the
+    returned array alive via ``pa.py_buffer``'s base reference.
+    """
+    from ray_shuffling_data_loader_tpu import native
+    buf = native.alloc_tracked_buffer(n)
+    view = memoryview(buf)
+    received = 0
+    while received < n:
+        got = sock.recv_into(view[received:], min(n - received, 1 << 20))
+        if not got:
+            raise TransportError("peer closed connection mid-message")
+        received += got
+    # memoryview: content-compares equal to bytes, supports the buffer
+    # protocol for pa.BufferReader, and keeps `buf` (and its pool bytes)
+    # alive exactly as long as anything references the payload.
+    return view
+
+
 class TcpTransport:
     """Point-to-point tagged message transport between shuffle hosts.
 
@@ -83,7 +107,9 @@ class TcpTransport:
         self.world = len(addresses)
         self._recv_timeout_s = recv_timeout_s
         self._reconnect_grace_s = reconnect_grace_s
-        self._inbox: Dict[Tuple[int, Tag], bytes] = {}
+        # Values are bytes-like: pool-backed memoryviews (remote) or the
+        # sender's payload object (self-sends).
+        self._inbox: Dict[Tuple[int, Tag], Any] = {}
         self._inbox_cv = threading.Condition()
         # src host id -> (reason, death monotonic time). A src is revived
         # (entry dropped) when a message arrives on a NEW connection — a
@@ -202,7 +228,7 @@ class TcpTransport:
                     raise TransportError(
                         f"bad magic {magic:#x} from peer (protocol mismatch)")
                 srcs_seen.add(src)
-                payload = _recv_exact(conn, length)
+                payload = _recv_payload(conn, length)
                 key = (src, (epoch, reducer, file_index))
                 with self._inbox_cv:
                     if key in self._inbox:
@@ -219,6 +245,9 @@ class TcpTransport:
                     # declared dead (sender redialed).
                     self._dead_srcs.pop(src, None)
                     self._inbox_cv.notify_all()
+                # Drop the frame's reference: otherwise this loop pins the
+                # last payload's pool bytes while blocked on the next header.
+                payload = None
         except (TransportError, OSError) as e:
             if not self._closed.is_set():
                 # Fail pending/future recvs from these srcs fast (after the
@@ -237,9 +266,15 @@ class TcpTransport:
             except OSError:
                 pass
 
-    def recv(self, src: int, tag: Tag,
-             timeout_s: Optional[float] = None) -> bytes:
+    def recv(self, src: int, tag: Tag, timeout_s: Optional[float] = None):
         """Block until the message with ``tag`` from host ``src`` arrives.
+
+        Returns a bytes-like object: for remote messages a ``memoryview``
+        over a pool-tracked recv buffer (content-compares equal to
+        ``bytes``, supports the buffer protocol for ``pa.BufferReader`` /
+        ``pa.py_buffer``, and keeps the pool bytes charged until every
+        reference is gone), for self-sends whatever the sender passed.
+        Callers needing an owned immutable copy should ``bytes(payload)``.
 
         Each message is consumed exactly once. Raises TransportTimeout after
         ``timeout_s`` (default: the transport-wide ``recv_timeout_s``) so a

@@ -70,6 +70,10 @@ ReduceTransform = Callable[[pa.Table], pa.Table]
 import os as _os
 _SCATTER_GATHER_THREADS = max(1, min(4, (_os.cpu_count() or 1)))
 
+# How long shuffle() polls for consumers to release tables when
+# max_inflight_bytes is exceeded before proceeding with a warning.
+_BUDGET_POLL_TIMEOUT_S = 30.0
+
 
 def _table_numpy_columns(table: pa.Table) -> Optional[Dict[str, np.ndarray]]:
     """{column -> 1-D ndarray} views of a table, or None if any column is
@@ -118,15 +122,17 @@ class FileTableCache:
         with self._lock:
             return self._tables.get(key)
 
-    def put(self, key: str, table: pa.Table) -> None:
+    def put(self, key: str, table: pa.Table) -> bool:
+        """Insert if the byte budget allows; returns True if inserted."""
         with self._lock:
             if key in self._tables:
-                return
+                return True
             nbytes = table.nbytes
             if self._bytes + nbytes > self.max_bytes:
-                return
+                return False
             self._tables[key] = table
             self._bytes += nbytes
+        return True
 
     @property
     def bytes_cached(self) -> int:
@@ -244,6 +250,11 @@ def shuffle_map(filename: str,
                 # this table are zero-copy.
                 table = table.combine_chunks()
                 file_cache.put(filename, table)
+            # Charge the decoded table to the buffer ledger for its
+            # lifetime — whether it now lives in the cache or only in this
+            # epoch's MapShard, 'wrapper alive' is 'bytes in flight'.
+            from ray_shuffling_data_loader_tpu import native
+            native.account_table(table)
         end_read = timeit.default_timer()
         rng = ops.map_rng(seed, epoch, file_index)
         assignments = ops.assign_reducers(table.num_rows, num_reducers, rng)
@@ -391,8 +402,14 @@ def _reduce_task(reduce_index: int, seed: int, epoch: int,
     (index arrays into the map tables) until the fused reduce gathers them.
     """
     chunks = [ref.result()[reduce_index] for ref in map_refs]
-    return shuffle_reduce(reduce_index, seed, epoch, chunks, stats_collector,
-                          reduce_transform)
+    shuffled = shuffle_reduce(reduce_index, seed, epoch, chunks,
+                              stats_collector, reduce_transform)
+    # In-flight reducer bytes: charged to the buffer ledger until every
+    # consumer drops the table (plasma's store-utilization role; the
+    # max_inflight_bytes throttle in shuffle() reads the same counter).
+    from ray_shuffling_data_loader_tpu import native
+    native.account_table(shuffled)
+    return shuffled
 
 
 def consume(trainer_idx: int,
@@ -462,13 +479,25 @@ def shuffle(filenames: Sequence[str],
             map_transform: Optional[MapTransform] = None,
             file_cache: Union[FileTableCache, None, str] = "auto",
             reduce_transform: Optional[ReduceTransform] = None,
-            task_retries: int = 0) -> Union[stats_mod.TrialStats, float]:
+            task_retries: int = 0,
+            max_inflight_bytes: Optional[int] = None
+            ) -> Union[stats_mod.TrialStats, float]:
     """Multi-epoch pipelined shuffle driver (reference: shuffle.py:79-160).
 
     Keeps at most ``max_concurrent_epochs`` epochs' shuffles in flight:
     before launching epoch E, blocks on the oldest incomplete epoch's
     reducers and then drops their refs so Arrow buffers already consumed
     by trainers can be freed (reference: shuffle.py:103-140).
+
+    ``max_inflight_bytes`` bounds TRANSIENT pipeline memory (in-flight map
+    and reducer tables as accounted by the buffer ledger, file-cache bytes
+    excluded): before launching a new epoch, waits — first by draining
+    older epochs, then by polling for consumers to release tables — until
+    under budget. The explicit analog of the reference operators sizing the
+    plasma store and disabling spill (reference: benchmarks/cluster.yaml:175).
+    The budget must exceed one epoch's working set; if consumers do not
+    release within ``_BUDGET_POLL_TIMEOUT_S`` the launch proceeds with a
+    warning rather than deadlocking.
 
     ``start_epoch`` > 0 (checkpoint resume) skips shuffling the already-
     fully-consumed epochs; epoch PRNG keys depend only on (seed, epoch),
@@ -500,11 +529,29 @@ def shuffle(filenames: Sequence[str],
     if pool is None:
         pool = ex.Executor(num_workers=num_workers,
                            task_retries=task_retries)
+    # Budget baselines: the ledger is process-global, so measure THIS
+    # shuffle's transient footprint as growth since its own start (minus
+    # its cache's growth). Other pipelines' static usage cancels out;
+    # their concurrent growth is attributed here only approximately.
+    from ray_shuffling_data_loader_tpu import native
+    _ledger_at_start = native.buffer_ledger().bytes_in_use()
+    _cache_at_start = (file_cache.bytes_cached
+                       if isinstance(file_cache, FileTableCache) else 0)
+
+    def _over_budget() -> bool:
+        if max_inflight_bytes is None:
+            return False
+        transient = native.buffer_ledger().bytes_in_use() - _ledger_at_start
+        if isinstance(file_cache, FileTableCache):
+            transient -= file_cache.bytes_cached - _cache_at_start
+        return transient > max_inflight_bytes
+
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}
         for epoch_idx in range(start_epoch, num_epochs):
             throttle_start = timeit.default_timer()
-            while len(in_progress) >= max_concurrent_epochs:
+            while in_progress and (len(in_progress) >= max_concurrent_epochs
+                                   or _over_budget()):
                 oldest_epoch = min(in_progress)
                 refs = in_progress.pop(oldest_epoch)
                 ex.wait(refs, num_returns=len(refs))
@@ -512,6 +559,31 @@ def shuffle(filenames: Sequence[str],
                     ref.result()  # propagate map/reduce failures (instant)
                 # Refs dropped here -> reducer Tables release once trainers
                 # finish with them (reference: shuffle.py:131-132).
+            if _over_budget():
+                # All prior epochs drained; wait for consumers to release
+                # tables (bounded — never deadlock the pipeline on a
+                # too-small budget).
+                import gc
+                import time as _time
+                deadline = timeit.default_timer() + _BUDGET_POLL_TIMEOUT_S
+                next_gc = 0.0  # collect now, then every ~1s: tables freed
+                # through reference cycles only decref the ledger at a
+                # cycle collection.
+                while _over_budget():
+                    now = timeit.default_timer()
+                    if now >= next_gc:
+                        gc.collect()
+                        next_gc = now + 1.0
+                        if not _over_budget():
+                            break
+                    if now >= deadline:
+                        logger.warning(
+                            "epoch %d launching over max_inflight_bytes=%d "
+                            "(consumers did not release within %.0fs)",
+                            epoch_idx, max_inflight_bytes,
+                            _BUDGET_POLL_TIMEOUT_S)
+                        break
+                    _time.sleep(0.02)
             throttle_duration = timeit.default_timer() - throttle_start
             if stats_collector is not None and throttle_duration > 1e-4:
                 stats_collector.throttle_done(epoch_idx, throttle_duration)
@@ -552,7 +624,8 @@ def shuffle_with_stats(
         map_transform: Optional[MapTransform] = None,
         file_cache: Union[FileTableCache, None, str] = "auto",
         reduce_transform: Optional[ReduceTransform] = None,
-        task_retries: int = 0
+        task_retries: int = 0,
+        max_inflight_bytes: Optional[int] = None
 ) -> Tuple[stats_mod.TrialStats, List]:
     """Shuffle plus a concurrent memory-utilization sampler thread
     (reference: shuffle.py:21-55). Forwards the workload hooks
@@ -569,7 +642,8 @@ def shuffle_with_stats(
                               map_transform=map_transform,
                               file_cache=file_cache,
                               reduce_transform=reduce_transform,
-                              task_retries=task_retries)
+                              task_retries=task_retries,
+                              max_inflight_bytes=max_inflight_bytes)
     finally:
         done_event.set()
     return trial_stats, store_stats
@@ -586,7 +660,8 @@ def shuffle_no_stats(filenames: Sequence[str],
                      map_transform: Optional[MapTransform] = None,
                      file_cache: Union[FileTableCache, None, str] = "auto",
                      reduce_transform: Optional[ReduceTransform] = None,
-                     task_retries: int = 0
+                     task_retries: int = 0,
+                     max_inflight_bytes: Optional[int] = None
                      ) -> Tuple[float, List]:
     """Duration-only variant (reference: shuffle.py:58-76)."""
     duration = shuffle(filenames, batch_consumer, num_epochs, num_reducers,
@@ -594,7 +669,8 @@ def shuffle_no_stats(filenames: Sequence[str],
                        num_workers=num_workers, collect_stats=False,
                        map_transform=map_transform, file_cache=file_cache,
                        reduce_transform=reduce_transform,
-                       task_retries=task_retries)
+                       task_retries=task_retries,
+                       max_inflight_bytes=max_inflight_bytes)
     return duration, []
 
 
@@ -613,6 +689,7 @@ def run_shuffle_in_background(
         file_cache: Union[FileTableCache, None, str] = "auto",
         reduce_transform: Optional[ReduceTransform] = None,
         task_retries: int = 0,
+        max_inflight_bytes: Optional[int] = None,
         on_failure: Optional[Callable[[BaseException], None]] = None
         ) -> ex.TaskRef:
     """Launch the whole multi-epoch shuffle as one background task.
@@ -641,7 +718,8 @@ def run_shuffle_in_background(
                            map_transform=map_transform,
                            file_cache=file_cache,
                            reduce_transform=reduce_transform,
-                           task_retries=task_retries)
+                           task_retries=task_retries,
+                           max_inflight_bytes=max_inflight_bytes)
         except BaseException as e:  # noqa: BLE001 - forwarded to consumers
             if on_failure is not None:
                 try:

@@ -339,11 +339,12 @@ def _read_rss_bytes() -> int:
 
 
 def get_memory_stats(sample_hbm: bool = False) -> MemorySample:
-    """One utilization sample: process RSS, native pool bytes, optional HBM."""
+    """One utilization sample: process RSS, pipeline pool bytes, optional
+    HBM. ``pool_bytes`` is the buffer ledger's total — file-cache tables,
+    in-flight reducer outputs, and transport recv buffers (the reference's
+    plasma store-utilization columns, reference: stats.py:263-270)."""
     from ray_shuffling_data_loader_tpu import native
-    pool_bytes = 0
-    if native.available():
-        pool_bytes = native.NativeBufferPool().bytes_in_use()
+    pool_bytes = native.buffer_ledger().bytes_in_use()
     hbm = 0
     if sample_hbm:
         try:

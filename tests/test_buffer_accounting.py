@@ -1,0 +1,161 @@
+"""Pipeline memory accounting through the buffer ledger (native pool or
+Python fallback): file cache, in-flight reducer tables, transport recv
+buffers, and the max_inflight_bytes throttle."""
+
+import gc
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import importlib
+
+from ray_shuffling_data_loader_tpu import multiqueue as mq
+from ray_shuffling_data_loader_tpu import native
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+
+shuffle_mod = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    mq._REGISTRY.clear()
+    yield
+    mq._REGISTRY.clear()
+    gc.collect()
+
+
+def write_files(tmp_path, num_files=2, rows_per_file=256):
+    filenames = []
+    for i in range(num_files):
+        n = rows_per_file
+        rng = np.random.default_rng(i)
+        table = pa.table({
+            "key": pa.array(range(i * n, i * n + n), type=pa.int64()),
+            "x": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+        })
+        path = str(tmp_path / f"input_{i}.parquet")
+        pq.write_table(table, path)
+        filenames.append(path)
+    return filenames
+
+
+def test_ledger_register_and_decref():
+    ledger = native.buffer_ledger()
+    base = ledger.bytes_in_use()
+    bid = ledger.register(1000)
+    assert ledger.bytes_in_use() == base + 1000
+    assert ledger.incref(bid) == 2
+    assert ledger.decref(bid) == 1
+    assert ledger.bytes_in_use() == base + 1000
+    assert ledger.decref(bid) == 0
+    assert ledger.bytes_in_use() == base
+
+
+def test_account_table_releases_on_gc():
+    ledger = native.buffer_ledger()
+    base = ledger.bytes_in_use()
+    table = pa.table({"x": np.arange(1000, dtype=np.int64)})
+    native.account_table(table)
+    assert ledger.bytes_in_use() >= base + 8000
+    del table
+    gc.collect()
+    assert ledger.bytes_in_use() == base
+
+
+def test_alloc_tracked_buffer_releases_on_gc():
+    ledger = native.buffer_ledger()
+    base = ledger.bytes_in_use()
+    buf = native.alloc_tracked_buffer(4096)
+    buf[:] = 7
+    assert ledger.bytes_in_use() == base + 4096
+    view = memoryview(buf)
+    del buf
+    gc.collect()
+    # The view still pins the pool bytes.
+    assert ledger.bytes_in_use() == base + 4096
+    assert view[0] == 7
+    del view
+    gc.collect()
+    assert ledger.bytes_in_use() == base
+
+
+def test_shuffle_charges_and_drains_pool_bytes(tmp_path):
+    """During a shuffle the ledger reports nonzero pipeline bytes; after
+    consumption and release it drains back to the baseline."""
+    filenames = write_files(tmp_path)
+    ledger = native.buffer_ledger()
+    gc.collect()
+    base = ledger.bytes_in_use()
+    high_water = []
+
+    ds = ShufflingDataset(
+        filenames, num_epochs=2, num_trainers=1, batch_size=64, rank=0,
+        num_reducers=2, max_concurrent_epochs=2, seed=0,
+        queue_name="pool-e2e")
+    for epoch in range(2):
+        ds.set_epoch(epoch)
+        keys = []
+        for batch in ds:
+            high_water.append(ledger.bytes_in_use() - base)
+            keys.extend(batch.column("key").to_pylist())
+        assert sorted(keys) == list(range(512))
+    assert max(high_water) > 0, "shuffle never charged the ledger"
+    del ds
+    gc.collect()
+    assert ledger.bytes_in_use() == base, "pipeline bytes did not drain"
+
+
+def test_max_inflight_bytes_shuffle_completes(tmp_path):
+    """A tiny transient-byte budget throttles epoch launches but must not
+    deadlock or corrupt the shuffle."""
+    filenames = write_files(tmp_path)
+    # Budget far below one epoch's footprint: every launch goes through the
+    # budget-wait path (bounded by the poll timeout), output must be intact.
+    shuffle_mod._BUDGET_POLL_TIMEOUT_S, saved = (
+        0.2, shuffle_mod._BUDGET_POLL_TIMEOUT_S)
+    try:
+        ds = ShufflingDataset(
+            filenames, num_epochs=3, num_trainers=1, batch_size=64, rank=0,
+            num_reducers=2, max_concurrent_epochs=2, seed=0,
+            queue_name="pool-budget", file_cache=None,
+            max_inflight_bytes=64)
+        for epoch in range(3):
+            ds.set_epoch(epoch)
+            keys = [k for b in ds for k in b.column("key").to_pylist()]
+            assert sorted(keys) == list(range(512)), f"epoch {epoch}"
+    finally:
+        shuffle_mod._BUDGET_POLL_TIMEOUT_S = saved
+
+
+def test_transport_recv_buffers_tracked():
+    from ray_shuffling_data_loader_tpu.parallel.transport import (
+        create_local_transports)
+    ledger = native.buffer_ledger()
+    world = create_local_transports(2)
+    try:
+        gc.collect()
+        base = ledger.bytes_in_use()
+        payload = np.full(1 << 16, 7, dtype=np.uint8).tobytes()
+        world[0].send(1, (0, 0, 0), payload)
+        got = world[1].recv(0, (0, 0, 0))
+        assert got == payload
+        assert ledger.bytes_in_use() >= base + (1 << 16)
+        del got
+        gc.collect()
+        assert ledger.bytes_in_use() == base
+    finally:
+        for t in world:
+            t.close()
+
+
+def test_memory_stats_reports_pool_bytes():
+    from ray_shuffling_data_loader_tpu import stats as stats_mod
+    ledger = native.buffer_ledger()
+    bid = ledger.register(123456)
+    try:
+        sample = stats_mod.get_memory_stats()
+        assert sample.pool_bytes >= 123456
+    finally:
+        ledger.decref(bid)

@@ -316,3 +316,25 @@ def test_persistent_epoch_rollover_prefetches_ahead(tmp_path):
     ds.set_epoch(1)
     assert len(list(ds)) == 256 // 16
     ds.close()
+
+
+def test_persistent_dropped_without_close_releases_producer(tmp_path):
+    """A dataset abandoned mid-epoch and simply dropped (no close()) must
+    not leak its producer: the producer holds no reference to the wrapper,
+    so GC fires the finalizer that stops the thread."""
+    import gc
+    import threading
+    filenames = write_files(tmp_path)
+    before = threading.active_count()
+    ds = jd.JaxShufflingDataset(
+        filenames, num_epochs=3, num_trainers=1, batch_size=16, rank=0,
+        feature_columns=["emb_1"], feature_types=[np.int32],
+        label_column="labels", num_reducers=2, seed=0,
+        queue_name="jax-gc-abandon", prefetch_size=1)
+    ds.set_epoch(0)
+    it = iter(ds)
+    next(it)
+    del it
+    del ds  # crash-style abandonment: no close() anywhere
+    gc.collect()
+    _assert_no_prefetch_thread(before)
