@@ -143,6 +143,14 @@ def main() -> None:
     # single epoch there is no warm-up and compile time is included).
     # RSDL_PROFILE_DIR=/tmp/tr captures a JAX profiler trace of the run.
     from ray_shuffling_data_loader_tpu.utils.tracing import maybe_profile
+    # Optional per-batch train-step emulation: BASELINE's >=90%-utilization
+    # contract is about a TRAINER's stall fraction, and with a near-zero
+    # consumer the pipeline is producer-bound by construction (stall% ~=
+    # 100% minus nothing). RSDL_BENCH_STEP_MS sleeps per batch to measure
+    # stall% at a realistic step time; rows/s is then gated by the step.
+    step_ms = float(os.environ.get("RSDL_BENCH_STEP_MS", 0))
+
+    import time as _time
     rows_consumed = 0
     start = timeit.default_timer()
     last = None
@@ -151,6 +159,8 @@ def main() -> None:
             ds.set_epoch(epoch)
             for features, label in ds:
                 last = touch(features, label)
+                if step_ms:
+                    _time.sleep(step_ms / 1e3)
                 if epoch > 0 or num_epochs == 1:
                     rows_consumed += label.shape[0]
             if epoch == 0 and num_epochs > 1:
@@ -190,9 +200,14 @@ def main() -> None:
         "unit": "rows/s",
         "vs_baseline": round(pipeline_rows_per_s / baseline_rows_per_s, 3),
         # Contract metric (BASELINE.md): consumer time spent waiting on the
-        # input pipeline, warm-up epoch excluded. <=10% == >=90% util.
+        # input pipeline, warm-up excluded. With step_ms=0 (default) the
+        # consumer does ~no work, so stall% ~= 100% is expected and rows/s
+        # is the signal; set RSDL_BENCH_STEP_MS to a realistic train-step
+        # time to measure the >=90%-utilization regime (<=10% stall).
         "stall_pct": round(stall_pct, 3),
         "stall_s": round(stall_s, 3),
+        "batch_wait_mean_ms": round(wait["mean"] * 1e3, 3),
+        "step_ms": step_ms,
         "cache_mode": "cold" if cold else "cached",
         # Fairness note: the pandas baseline is a rate over a quarter of
         # the files (it is single-process and O(minutes) on the full set).

@@ -51,7 +51,9 @@ def run_trials(num_epochs: int,
                    DEFAULT_UTILIZATION_SAMPLE_PERIOD),
                num_trials: Optional[int] = None,
                trials_timeout: Optional[float] = None,
-               seed: int = 0) -> List[Tuple]:
+               seed: int = 0,
+               map_transform=None,
+               reduce_transform=None) -> List[Tuple]:
     """Run fixed-count or time-bounded trials
     (reference: benchmark.py:26-68)."""
     all_stats = []
@@ -61,7 +63,8 @@ def run_trials(num_epochs: int,
             stats, store_stats = _one_trial(
                 num_epochs, filenames, num_reducers, num_trainers,
                 max_concurrent_epochs, collect_stats,
-                utilization_sample_period, seed + trial)
+                utilization_sample_period, seed + trial,
+                map_transform, reduce_transform)
             _log_trial(trial, stats)
             all_stats.append((stats, store_stats))
     elif trials_timeout is not None:
@@ -72,7 +75,8 @@ def run_trials(num_epochs: int,
             stats, store_stats = _one_trial(
                 num_epochs, filenames, num_reducers, num_trainers,
                 max_concurrent_epochs, collect_stats,
-                utilization_sample_period, seed + trial)
+                utilization_sample_period, seed + trial,
+                map_transform, reduce_transform)
             _log_trial(trial, stats)
             all_stats.append((stats, store_stats))
             trial += 1
@@ -83,15 +87,18 @@ def run_trials(num_epochs: int,
 
 def _one_trial(num_epochs, filenames, num_reducers, num_trainers,
                max_concurrent_epochs, collect_stats,
-               utilization_sample_period, seed):
+               utilization_sample_period, seed,
+               map_transform=None, reduce_transform=None):
     if collect_stats:
         return shuffle_with_stats(
             filenames, dummy_batch_consumer, num_epochs, num_reducers,
             num_trainers, max_concurrent_epochs, seed=seed,
-            utilization_sample_period=utilization_sample_period)
+            utilization_sample_period=utilization_sample_period,
+            map_transform=map_transform, reduce_transform=reduce_transform)
     return shuffle_no_stats(
         filenames, dummy_batch_consumer, num_epochs, num_reducers,
-        num_trainers, max_concurrent_epochs, seed=seed)
+        num_trainers, max_concurrent_epochs, seed=seed,
+        map_transform=map_transform, reduce_transform=reduce_transform)
 
 
 def _log_trial(trial, stats):
@@ -126,6 +133,16 @@ def parse_args(argv=None):
     parser.add_argument("--overwrite-stats", action="store_true")
     parser.add_argument("--unique-stats", action="store_true")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--workload", choices=["dlrm", "imagenet", "bert"], default="dlrm",
+        help="dlrm: tabular DLRM rows (reference DATA_SPEC, default); "
+             "imagenet: encoded images with decode inside shuffle reducers "
+             "(BASELINE config 3 — --image-size controls H=W); bert: token "
+             "sequences with the narrow-dtype cast at the map stage")
+    parser.add_argument("--image-size", type=int, default=64,
+                        help="imagenet workload: square image edge length")
+    parser.add_argument("--seq-len", type=int, default=128,
+                        help="bert workload: tokens per row")
     args = parser.parse_args(argv)
     if args.num_trials is None and args.trials_timeout is None:
         args.num_trials = 3
@@ -151,21 +168,63 @@ def main(argv=None) -> None:
         logger.info("Reusing %d files from %s", len(filenames),
                     args.data_dir)
     else:
-        logger.info("Generating %d rows over %d files in %s",
-                    args.num_rows, args.num_files, args.data_dir)
+        logger.info("Generating %d rows over %d files in %s "
+                    "(workload: %s)", args.num_rows, args.num_files,
+                    args.data_dir, args.workload)
         start = timeit.default_timer()
-        filenames, num_bytes = datagen.generate_data(
-            args.num_rows, args.num_files, args.num_row_groups_per_file,
-            args.max_row_group_skew, args.data_dir, seed=args.seed)
+        if args.workload == "imagenet":
+            from ray_shuffling_data_loader_tpu.workloads import imagenet
+            filenames, num_bytes = imagenet.generate_imagenet_parquet(
+                args.num_rows, args.num_files, args.data_dir,
+                height=args.image_size, width=args.image_size,
+                seed=args.seed)
+        elif args.workload == "bert":
+            from ray_shuffling_data_loader_tpu.workloads import bert_mlm
+            filenames, num_bytes = bert_mlm.generate_tokenized_parquet(
+                args.num_rows, args.num_files, args.data_dir,
+                seq_len=args.seq_len, seed=args.seed)
+        else:
+            filenames, num_bytes = datagen.generate_data(
+                args.num_rows, args.num_files,
+                args.num_row_groups_per_file, args.max_row_group_skew,
+                args.data_dir, seed=args.seed)
         logger.info("Generated %.1f MB in %.2fs", num_bytes / 1e6,
                     timeit.default_timer() - start)
+
+    # Workload hooks: ImageNet decodes encoded images inside shuffle
+    # reducers (BASELINE config 3); DLRM casts to the narrowest covering
+    # dtypes at the map stage so every downstream byte is narrow.
+    map_transform = reduce_transform = None
+    if args.workload == "imagenet":
+        from ray_shuffling_data_loader_tpu.workloads import imagenet
+        reduce_transform = imagenet.decode_transform(
+            args.image_size, args.image_size)
+    elif args.workload == "bert":
+        from ray_shuffling_data_loader_tpu.jax_dataset import (
+            make_cast_transform)
+        from ray_shuffling_data_loader_tpu.workloads.bert_mlm import (
+            bert_mlm_spec)
+        spec = bert_mlm_spec(args.seq_len)
+        map_transform = make_cast_transform(
+            spec["feature_columns"], spec["feature_types"],
+            spec["label_column"], spec["label_type"])
+    elif args.workload == "dlrm":
+        from ray_shuffling_data_loader_tpu.jax_dataset import (
+            make_cast_transform)
+        from ray_shuffling_data_loader_tpu.workloads.dlrm_criteo import (
+            dlrm_spec)
+        spec = dlrm_spec()
+        map_transform = make_cast_transform(
+            spec["feature_columns"], spec["feature_types"],
+            spec["label_column"], spec["label_type"])
 
     all_stats = run_trials(
         args.num_epochs, filenames, args.num_reducers, args.num_trainers,
         args.max_concurrent_epochs, collect_stats=not args.no_stats,
         utilization_sample_period=args.utilization_sample_period,
         num_trials=args.num_trials, trials_timeout=args.trials_timeout,
-        seed=args.seed)
+        seed=args.seed, map_transform=map_transform,
+        reduce_transform=reduce_transform)
 
     if args.no_stats:
         durations = [d for d, _ in all_stats]

@@ -471,24 +471,34 @@ class JaxShufflingDataset:
             return
         if skip_batches < 0:
             raise ValueError(f"skip_batches must be >= 0, got {skip_batches}")
+        # Validate BEFORE destroying any in-flight iterator, so an illegal
+        # call leaves the current epoch resumable. A suspended (mid-epoch)
+        # iterator counts its epoch as consumed once we finalize it below,
+        # so the expected argument is one past it.
+        import inspect
+        gen_state = (inspect.getgeneratorstate(self._active_gen)
+                     if self._active_gen is not None else None)
+        if gen_state == inspect.GEN_RUNNING:
+            raise RuntimeError(
+                "set_epoch called while another thread is iterating "
+                "this dataset")
+        expected = (self._next_epoch + 1
+                    if gen_state == inspect.GEN_SUSPENDED
+                    else self._next_epoch)
+        if epoch != expected:
+            raise ValueError(
+                f"persistent_prefetch requires sequential epochs: expected "
+                f"set_epoch({expected}), got set_epoch({epoch}). "
+                "Construct with persistent_prefetch=False for out-of-order "
+                "epoch iteration.")
         if self._active_gen is not None:
             # Finalize a previous epoch's iterator NOW (a consumer that
             # broke out mid-epoch and moved on without close()-ing the
             # iterator must not depend on GC timing): closing it runs the
             # generator's finally, which marks that epoch consumed.
-            try:
-                self._active_gen.close()
-            except ValueError:
-                raise RuntimeError(
-                    "set_epoch called while another thread is iterating "
-                    "this dataset")
+            self._active_gen.close()
             self._active_gen = None
-        if epoch != self._next_epoch:
-            raise ValueError(
-                f"persistent_prefetch requires sequential epochs: expected "
-                f"set_epoch({self._next_epoch}), got set_epoch({epoch}). "
-                "Construct with persistent_prefetch=False for out-of-order "
-                "epoch iteration.")
+        assert epoch == self._next_epoch, (epoch, self._next_epoch)
         with self._lock:
             if epoch in self._started_epochs:
                 # Producer already ran (or is running) this epoch's convert+
@@ -629,24 +639,19 @@ class JaxShufflingDataset:
             except _queue.Empty:
                 pass
             try:
-                # Wake a consumer blocked in the iterator's get(): the
-                # producer is stopped, so nothing else will.
+                # A live consumer — blocked in the iterator's get() or
+                # about to call next() — gets this instead of hanging on
+                # the drained queue or ending its epoch loop with silent
+                # truncation. (Deliberately no generator.close() here: its
+                # effect would depend on whether the consumer happened to
+                # be suspended or blocked at this instant; the poison item
+                # raises consistently in both timings.)
                 self._out.put_nowait(
-                    RuntimeError("JaxShufflingDataset closed while a "
-                                 "consumer was blocked on a batch"))
+                    RuntimeError("JaxShufflingDataset was closed while the "
+                                 "epoch was still being iterated"))
             except _queue.Full:
                 pass
-        if self._active_gen is not None:
-            try:
-                # A suspended iterator resumed after close() would block on
-                # the drained queue; finalize it instead.
-                self._active_gen.close()
-            except ValueError:
-                # Generator currently executing in the consumer thread
-                # (close() from a watchdog): the poison item above will
-                # raise there instead.
-                pass
-            self._active_gen = None
+        self._active_gen = None
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -246,6 +246,10 @@ class NativeBufferPool:
         if size < 0:
             raise KeyError(f"unknown buffer id {buf_id}")
         data = lib.rsdl_buffer_data(buf_id)
+        if not data:
+            # register()-created ledger entries carry no memory; wrapping
+            # the NULL pointer would hand out a segfaulting array.
+            raise KeyError(f"buffer id {buf_id} is accounting-only")
         return np.ctypeslib.as_array(
             ctypes.cast(data, ctypes.POINTER(ctypes.c_uint8)), shape=(size,))
 

@@ -191,15 +191,28 @@ def _pick_block(seq: int, preferred: int) -> int:
     return block
 
 
+def _pick_aligned_block(seq: int, preferred: int, align: int) -> int:
+    """Largest multiple of ``align`` <= preferred that divides
+    ``round_up(seq, align)`` — i.e. the biggest aligned tile that adds no
+    padding beyond alignment. A fixed preferred block would pad e.g.
+    S=768 up to 1024 with 512 blocks (~33% wasted FLOPs); this picks 384.
+    ``align`` always qualifies, so the loop terminates."""
+    target = _round_up(seq, align)
+    block = min(_round_up(preferred, align), target)
+    while target % block:
+        block -= align
+    return block
+
+
 def _plan(sq: int, sk: int, block_q: int, block_k: int, interpret: bool):
     """(bq, bk, sq_pad, sk_pad). Interpret mode: any divisor works.
     TPU: blocks must be (8, 128)-tile aligned, so pad the sequence dims
     up to aligned block multiples instead of shrinking blocks."""
     if interpret:
         return (_pick_block(sq, block_q), _pick_block(sk, block_k), sq, sk)
-    bq = min(_round_up(block_q, 8), _round_up(sq, 8))
+    bq = _pick_aligned_block(sq, block_q, 8)
     sq_pad = _round_up(sq, bq)
-    bk = min(max(_round_up(block_k, 128), 128), _round_up(sk, 128))
+    bk = _pick_aligned_block(sk, block_k, 128)
     sk_pad = _round_up(sk, bk)
     return bq, bk, sq_pad, sk_pad
 

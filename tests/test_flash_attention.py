@@ -139,7 +139,12 @@ def test_rejects_non_keyside_bias(rng):
     (512, 512, 128, 128, (128, 128, 512, 512)),     # aligned, no padding
     (127, 127, 128, 128, (128, 128, 128, 128)),     # prime S -> pad up
     (48, 48, 16, 16, (16, 128, 48, 128)),           # small S, K padded
-    (520, 200, 128, 128, (128, 128, 640, 256)),     # both padded
+    # 520 = 8*65: the largest 8-aligned divisor (104) beats padding to
+    # a multiple of the preferred 128 (640 rows -> 520 rows).
+    (520, 200, 128, 128, (104, 128, 520, 256)),
+    # 768 with 512-preferred blocks must shrink to 384, not pad to 1024
+    # (fixed-512 blocks added ~33% masked FLOPs here).
+    (768, 768, 512, 512, (384, 384, 768, 768)),
 ])
 def test_tpu_block_plan_is_tile_aligned(sq, sk, block_q, block_k, exp):
     bq, bk, sq_pad, sk_pad = fa._plan(sq, sk, block_q, block_k,
