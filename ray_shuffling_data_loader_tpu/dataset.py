@@ -28,6 +28,7 @@ import importlib
 
 from ray_shuffling_data_loader_tpu import executor as ex
 from ray_shuffling_data_loader_tpu import multiqueue as mq
+from ray_shuffling_data_loader_tpu import spill
 
 # Not ``from ray_shuffling_data_loader_tpu import shuffle``: the package
 # __init__ rebinds that attribute to the shuffle() function, so attribute
@@ -122,7 +123,8 @@ def create_batch_queue_and_shuffle(
         reduce_transform=None,
         task_retries: int = 0,
         file_cache="auto",
-        max_inflight_bytes: Optional[int] = None):
+        max_inflight_bytes: Optional[int] = None,
+        spill_dir: Optional[str] = None):
     """Driver-mode helper: create the queue and start the shuffle before any
     trainer exists, so every rank can be a pure consumer
     (reference: dataset.py:17-51)."""
@@ -153,6 +155,7 @@ def create_batch_queue_and_shuffle(
         task_retries=task_retries,
         file_cache=file_cache,
         max_inflight_bytes=max_inflight_bytes,
+        spill_dir=spill_dir,
         on_failure=make_failure_broadcaster(batch_queue,
                                             num_epochs * num_trainers))
     return batch_queue, shuffle_result
@@ -194,7 +197,8 @@ class ShufflingDataset:
                  reduce_transform=None,
                  task_retries: int = 0,
                  file_cache="auto",
-                 max_inflight_bytes: Optional[int] = None):
+                 max_inflight_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
         if num_reducers is None:
             num_reducers = default_num_reducers(num_trainers)
         self._batch_size = batch_size
@@ -213,7 +217,8 @@ class ShufflingDataset:
                         reduce_transform=reduce_transform,
                         task_retries=task_retries,
                         file_cache=file_cache,
-                        max_inflight_bytes=max_inflight_bytes))
+                        max_inflight_bytes=max_inflight_bytes,
+                        spill_dir=spill_dir))
                 self._owns_queue = True
             else:
                 self._batch_queue = mq.MultiQueue(
@@ -305,9 +310,12 @@ class ShufflingDataset:
                     "the shuffle driver died; no more batches are coming"
                 ) from ref.error
             # In-process queues carry TaskRefs; remote queue clients
-            # (multiqueue_service.py) deliver materialized tables.
+            # (multiqueue_service.py) deliver materialized tables. A
+            # budget-spilled reducer output arrives as a lazy handle and
+            # is memory-mapped back here (spill.py).
             table: pa.Table = (ref.result() if hasattr(ref, "result")
                                else ref)
+            table = spill.unwrap(table)
             if to_skip:
                 if table.num_rows <= to_skip:
                     to_skip -= table.num_rows

@@ -376,6 +376,9 @@ class JaxShufflingDataset:
             decoded files.
         max_inflight_bytes: byte budget for transient shuffle memory
             (in-flight map + reducer tables); see ``shuffle.shuffle``.
+        spill_dir: with ``max_inflight_bytes``, spill over-budget reducer
+            outputs to Arrow IPC files here instead of throttling
+            (plasma's spill role; see spill.py).
     """
 
     def __init__(self,
@@ -409,7 +412,8 @@ class JaxShufflingDataset:
                  reduce_transform=None,
                  persistent_prefetch: bool = True,
                  file_cache="auto",
-                 max_inflight_bytes: Optional[int] = None):
+                 max_inflight_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
         (self._feature_columns, self._feature_shapes, self._feature_types,
          self._label_column, self._label_shape, self._label_type) = (
              _normalize_jax_data_spec(feature_columns, feature_shapes,
@@ -440,7 +444,7 @@ class JaxShufflingDataset:
             num_workers=num_workers, queue_name=queue_name,
             start_epoch=start_epoch, map_transform=map_transform,
             reduce_transform=reduce_transform, file_cache=file_cache,
-            max_inflight_bytes=max_inflight_bytes)
+            max_inflight_bytes=max_inflight_bytes, spill_dir=spill_dir)
         self._mesh = mesh
         self._data_axis = data_axis
         self._prefetch_size = max(1, prefetch_size)
@@ -496,7 +500,14 @@ class JaxShufflingDataset:
             # broke out mid-epoch and moved on without close()-ing the
             # iterator must not depend on GC timing): closing it runs the
             # generator's finally, which marks that epoch consumed.
-            self._active_gen.close()
+            try:
+                self._active_gen.close()
+            except ValueError:
+                # TOCTOU with the state probe above: the other thread
+                # resumed the generator in between.
+                raise RuntimeError(
+                    "set_epoch called while another thread is iterating "
+                    "this dataset")
             self._active_gen = None
         assert epoch == self._next_epoch, (epoch, self._next_epoch)
         with self._lock:
@@ -632,6 +643,12 @@ class JaxShufflingDataset:
         """
         self._closed = True
         self._stop.set()
+        if self._thread is not None:
+            # Join BEFORE draining: the producer notices the stop event
+            # within one bounded-put poll (0.1s) and exits, so nothing
+            # refills the queue between the drain and the poison below.
+            self._thread.join(timeout=5)
+            self._thread = None
         if self._out is not None:
             try:
                 while True:
@@ -650,11 +667,8 @@ class JaxShufflingDataset:
                     RuntimeError("JaxShufflingDataset was closed while the "
                                  "epoch was still being iterated"))
             except _queue.Full:
-                pass
+                pass  # unreachable after the join+drain above
         self._active_gen = None
-        if self._thread is not None:
-            self._thread.join(timeout=5)
-            self._thread = None
 
     # -- per-epoch producer (persistent_prefetch=False) --------------------
 

@@ -116,6 +116,8 @@ class QueueServer:
                     try:
                         table = (item.result() if hasattr(item, "result")
                                  else item)
+                        from ray_shuffling_data_loader_tpu import spill
+                        table = spill.unwrap(table)
                         payload = _serialize(table)
                     except Exception as e:  # noqa: BLE001 - forwarded
                         # A failed shuffle task ref: the consumer gets the

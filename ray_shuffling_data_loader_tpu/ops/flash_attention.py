@@ -192,15 +192,22 @@ def _pick_block(seq: int, preferred: int) -> int:
 
 
 def _pick_aligned_block(seq: int, preferred: int, align: int) -> int:
-    """Largest multiple of ``align`` <= preferred that divides
-    ``round_up(seq, align)`` — i.e. the biggest aligned tile that adds no
-    padding beyond alignment. A fixed preferred block would pad e.g.
-    S=768 up to 1024 with 512 blocks (~33% wasted FLOPs); this picks 384.
-    ``align`` always qualifies, so the loop terminates."""
+    """Aligned tile size: the largest multiple of ``align`` <= preferred
+    that divides ``round_up(seq, align)`` (no padding beyond alignment) —
+    a fixed preferred block would pad e.g. S=768 up to 1024 with 512
+    blocks (~33% wasted FLOPs); this picks 384. But never a DEGENERATE
+    divisor: below ~64 rows the MXU runs mostly idle per pass (S=1016 =
+    8*127 has no nontrivial aligned divisor), and padding up to the
+    preferred block is far cheaper than 8-row tiles — so when only
+    tiny divisors exist, fall back to the preferred block and pad."""
     target = _round_up(seq, align)
-    block = min(_round_up(preferred, align), target)
-    while target % block:
+    cap = min(_round_up(preferred, align), target)
+    floor = max(align, min(cap, 64))
+    block = cap
+    while target % block and block > floor:
         block -= align
+    if target % block:
+        return cap  # only degenerate divisors: pad instead
     return block
 
 

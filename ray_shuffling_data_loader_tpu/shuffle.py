@@ -393,8 +393,8 @@ def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
 
 def _reduce_task(reduce_index: int, seed: int, epoch: int,
                  map_refs: Sequence[ex.TaskRef], stats_collector,
-                 reduce_transform: Optional[ReduceTransform] = None
-                 ) -> pa.Table:
+                 reduce_transform: Optional[ReduceTransform] = None,
+                 spill_manager=None) -> pa.Table:
     """Executor wrapper: resolve this reducer's chunk from every map output.
 
     Equivalent of Ray resolving ``shuffle_reduce.remote(*refs)`` argument
@@ -409,6 +409,11 @@ def _reduce_task(reduce_index: int, seed: int, epoch: int,
     # max_inflight_bytes throttle in shuffle() reads the same counter).
     from ray_shuffling_data_loader_tpu import native
     native.account_table(shuffled)
+    if spill_manager is not None:
+        # Over-budget outputs go to disk; consumers reload lazily
+        # (spill.py). The SpilledTable handle replaces the table here, so
+        # the in-memory copy is released as soon as this task returns.
+        shuffled = spill_manager.maybe_spill(shuffled)
     return shuffled
 
 
@@ -440,8 +445,8 @@ def shuffle_epoch(epoch: int,
                   stats_collector=None,
                   map_transform: Optional[MapTransform] = None,
                   file_cache: Optional[FileTableCache] = None,
-                  reduce_transform: Optional[ReduceTransform] = None
-                  ) -> List[ex.TaskRef]:
+                  reduce_transform: Optional[ReduceTransform] = None,
+                  spill_manager=None) -> List[ex.TaskRef]:
     """Launch one epoch's map/reduce and route outputs to trainers
     (reference: shuffle.py:163-196). Returns the reducer TaskRefs."""
     if stats_collector is not None:
@@ -453,7 +458,7 @@ def shuffle_epoch(epoch: int,
     ]
     reduce_refs = [
         pool.submit(_reduce_task, reduce_index, seed, epoch, map_refs,
-                    stats_collector, reduce_transform)
+                    stats_collector, reduce_transform, spill_manager)
         for reduce_index in range(num_reducers)
     ]
     for trainer_idx, batches in enumerate(
@@ -480,7 +485,8 @@ def shuffle(filenames: Sequence[str],
             file_cache: Union[FileTableCache, None, str] = "auto",
             reduce_transform: Optional[ReduceTransform] = None,
             task_retries: int = 0,
-            max_inflight_bytes: Optional[int] = None
+            max_inflight_bytes: Optional[int] = None,
+            spill_dir: Optional[str] = None
             ) -> Union[stats_mod.TrialStats, float]:
     """Multi-epoch pipelined shuffle driver (reference: shuffle.py:79-160).
 
@@ -498,6 +504,12 @@ def shuffle(filenames: Sequence[str],
     The budget must exceed one epoch's working set; if consumers do not
     release within ``_BUDGET_POLL_TIMEOUT_S`` the launch proceeds with a
     warning rather than deadlocking.
+
+    ``spill_dir`` (with ``max_inflight_bytes``) enables plasma's spill
+    role: reducer outputs produced while over budget are written to Arrow
+    IPC files under a scratch subdir and lazily memory-mapped back by the
+    consumer (spill.py) — budgets smaller than one epoch's working set
+    then make progress instead of warning.
 
     ``start_epoch`` > 0 (checkpoint resume) skips shuffling the already-
     fully-consumed epochs; epoch PRNG keys depend only on (seed, epoch),
@@ -546,6 +558,11 @@ def shuffle(filenames: Sequence[str],
             transient -= file_cache.bytes_cached - _cache_at_start
         return transient > max_inflight_bytes
 
+    spill_manager = None
+    if spill_dir is not None and max_inflight_bytes is not None:
+        from ray_shuffling_data_loader_tpu.spill import SpillManager
+        spill_manager = SpillManager(spill_dir, _over_budget)
+
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}
         for epoch_idx in range(start_epoch, num_epochs):
@@ -559,10 +576,11 @@ def shuffle(filenames: Sequence[str],
                     ref.result()  # propagate map/reduce failures (instant)
                 # Refs dropped here -> reducer Tables release once trainers
                 # finish with them (reference: shuffle.py:131-132).
-            if _over_budget():
+            if _over_budget() and spill_manager is None:
                 # All prior epochs drained; wait for consumers to release
                 # tables (bounded — never deadlock the pipeline on a
-                # too-small budget).
+                # too-small budget). With a spill manager the launch
+                # proceeds instead: over-budget reducer outputs go to disk.
                 import gc
                 import time as _time
                 deadline = timeit.default_timer() + _BUDGET_POLL_TIMEOUT_S
@@ -593,7 +611,7 @@ def shuffle(filenames: Sequence[str],
             in_progress[epoch_idx] = shuffle_epoch(
                 epoch_idx, filenames, batch_consumer, num_reducers,
                 num_trainers, pool, seed, start, stats_collector,
-                map_transform, file_cache, reduce_transform)
+                map_transform, file_cache, reduce_transform, spill_manager)
         # Final drain: wait for all remaining reducer tasks
         # (reference: shuffle.py:148-151).
         for epoch_idx in sorted(in_progress):
@@ -604,6 +622,10 @@ def shuffle(filenames: Sequence[str],
     finally:
         if owns_pool:
             pool.shutdown()
+        if spill_manager is not None:
+            # Scratch-dir deletion is reference-managed (consumers may
+            # still be draining spilled batches from the queue).
+            spill_manager.report()
 
     if stats_collector is not None:
         stats_collector.trial_done()
@@ -625,7 +647,8 @@ def shuffle_with_stats(
         file_cache: Union[FileTableCache, None, str] = "auto",
         reduce_transform: Optional[ReduceTransform] = None,
         task_retries: int = 0,
-        max_inflight_bytes: Optional[int] = None
+        max_inflight_bytes: Optional[int] = None,
+        spill_dir: Optional[str] = None
 ) -> Tuple[stats_mod.TrialStats, List]:
     """Shuffle plus a concurrent memory-utilization sampler thread
     (reference: shuffle.py:21-55). Forwards the workload hooks
@@ -643,7 +666,8 @@ def shuffle_with_stats(
                               file_cache=file_cache,
                               reduce_transform=reduce_transform,
                               task_retries=task_retries,
-                              max_inflight_bytes=max_inflight_bytes)
+                              max_inflight_bytes=max_inflight_bytes,
+                              spill_dir=spill_dir)
     finally:
         done_event.set()
     return trial_stats, store_stats
@@ -661,7 +685,8 @@ def shuffle_no_stats(filenames: Sequence[str],
                      file_cache: Union[FileTableCache, None, str] = "auto",
                      reduce_transform: Optional[ReduceTransform] = None,
                      task_retries: int = 0,
-                     max_inflight_bytes: Optional[int] = None
+                     max_inflight_bytes: Optional[int] = None,
+                     spill_dir: Optional[str] = None
                      ) -> Tuple[float, List]:
     """Duration-only variant (reference: shuffle.py:58-76)."""
     duration = shuffle(filenames, batch_consumer, num_epochs, num_reducers,
@@ -670,7 +695,8 @@ def shuffle_no_stats(filenames: Sequence[str],
                        map_transform=map_transform, file_cache=file_cache,
                        reduce_transform=reduce_transform,
                        task_retries=task_retries,
-                       max_inflight_bytes=max_inflight_bytes)
+                       max_inflight_bytes=max_inflight_bytes,
+                       spill_dir=spill_dir)
     return duration, []
 
 
@@ -690,6 +716,7 @@ def run_shuffle_in_background(
         reduce_transform: Optional[ReduceTransform] = None,
         task_retries: int = 0,
         max_inflight_bytes: Optional[int] = None,
+        spill_dir: Optional[str] = None,
         on_failure: Optional[Callable[[BaseException], None]] = None
         ) -> ex.TaskRef:
     """Launch the whole multi-epoch shuffle as one background task.
@@ -719,7 +746,8 @@ def run_shuffle_in_background(
                            file_cache=file_cache,
                            reduce_transform=reduce_transform,
                            task_retries=task_retries,
-                           max_inflight_bytes=max_inflight_bytes)
+                           max_inflight_bytes=max_inflight_bytes,
+                           spill_dir=spill_dir)
         except BaseException as e:  # noqa: BLE001 - forwarded to consumers
             if on_failure is not None:
                 try:

@@ -1,0 +1,127 @@
+"""Disk spill tier for reducer outputs (plasma's spill role, made explicit).
+
+Ray's plasma store spills objects to disk under memory pressure; the
+reference's operators size the store and disable that spilling outright
+(reference: benchmarks/cluster.yaml:175, examples/horovod/cluster.yaml:98)
+because an unpredictable spill mid-trial wrecks throughput. Here the
+policy is explicit and local: when a shuffle runs with ``spill_dir`` set
+and its transient buffer-ledger bytes exceed ``max_inflight_bytes``,
+freshly-produced reducer outputs are written to Arrow IPC files and
+replaced by lazy :class:`SpilledTable` handles; the consumer loads each
+handle once — memory-mapped, so reload is a page-in, not a decode — right
+before re-batching. Without ``spill_dir`` the budget only throttles epoch
+launches (shuffle.py), which is the reference's "no spill" operating
+point.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+import threading
+import weakref
+from typing import Callable, Optional
+
+import pyarrow as pa
+
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+
+class SpilledTable:
+    """Lazy handle to one reducer output on disk.
+
+    ``load()`` memory-maps the IPC file, unlinks it (the mapping keeps the
+    pages alive on POSIX), accounts the bytes to the buffer ledger like
+    any in-flight table, and caches the result so repeated loads are safe.
+
+    The handle holds its :class:`SpillManager` alive: the scratch
+    directory is removed by the manager's finalizer only after the LAST
+    outstanding handle is gone, so a slow consumer still draining the
+    batch queue after the shuffle driver returned can always load.
+    """
+
+    __slots__ = ("_path", "num_rows", "_table", "_lock", "_manager",
+                 "__weakref__")
+
+    def __init__(self, path: str, num_rows: int, manager: "SpillManager"):
+        self._path = path
+        self.num_rows = num_rows
+        self._table: Optional[pa.Table] = None
+        self._lock = threading.Lock()
+        self._manager = manager
+        # A handle dropped without ever being loaded (abandoned run)
+        # deletes its file; idempotent with load()'s unlink.
+        weakref.finalize(self, _unlink_quiet, path)
+
+    def load(self) -> pa.Table:
+        with self._lock:
+            if self._table is None:
+                with pa.memory_map(self._path) as source:
+                    self._table = pa.ipc.open_file(source).read_all()
+                _unlink_quiet(self._path)
+                from ray_shuffling_data_loader_tpu import native
+                native.account_table(self._table)
+            return self._table
+
+
+def _unlink_quiet(path: str) -> None:
+    try:
+        os.unlink(path)
+    except OSError:
+        pass
+
+
+class SpillManager:
+    """Per-shuffle spill policy + scratch directory.
+
+    ``over_budget`` is the shuffle driver's own transient-bytes predicate,
+    so spill and epoch-launch throttling read the same meter. The scratch
+    directory's lifetime is reference-managed: every handle pins the
+    manager, and the manager's finalizer removes the directory — so
+    teardown happens after the last consumer, not when the driver exits.
+    """
+
+    def __init__(self, spill_dir: str, over_budget: Callable[[], bool]):
+        os.makedirs(spill_dir, exist_ok=True)
+        self._dir = tempfile.mkdtemp(prefix="rsdl-spill-", dir=spill_dir)
+        self._over_budget = over_budget
+        self._seq = 0
+        self._lock = threading.Lock()
+        self.spill_count = 0
+        self.spilled_bytes = 0
+        weakref.finalize(self, shutil.rmtree, self._dir, True)
+
+    def maybe_spill(self, table: pa.Table):
+        """Spill ``table`` if the pipeline is over its transient budget;
+        returns the table itself or a :class:`SpilledTable` handle."""
+        if table.num_rows == 0 or not self._over_budget():
+            return table
+        with self._lock:
+            path = os.path.join(self._dir, f"reduce_{self._seq}.arrow")
+            self._seq += 1
+        with pa.OSFile(path, "wb") as sink:
+            with pa.ipc.new_file(sink, table.schema) as writer:
+                writer.write_table(table)
+        size = os.path.getsize(path)
+        with self._lock:
+            self.spill_count += 1
+            self.spilled_bytes += size
+        return SpilledTable(path, table.num_rows, self)
+
+    def report(self) -> None:
+        """Log spill totals (called when the shuffle driver finishes; the
+        scratch dir itself is removed by the finalizer once the last
+        outstanding :class:`SpilledTable` is consumed or dropped)."""
+        if self.spill_count:
+            logger.info("spilled %d reducer outputs (%.1f MB) to disk",
+                        self.spill_count, self.spilled_bytes / 1e6)
+
+
+def unwrap(table_or_handle):
+    """Materialize a possibly-spilled table (consumer-side hook)."""
+    if isinstance(table_or_handle, SpilledTable):
+        return table_or_handle.load()
+    return table_or_handle

@@ -165,3 +165,15 @@ def test_prep_bias_masks_padded_keys(rng):
     # no bias + padding -> synthetic mask bias
     synth = fa._prep_bias(None, 2, 48, 128)
     assert synth is not None and float(synth[..., 48:].max()) == fa._MASK
+
+
+@pytest.mark.parametrize("seq,preferred,align,exp", [
+    (768, 512, 8, 384),     # largest aligned divisor wins over padding
+    (520, 512, 8, 104),     # 104 >= floor: no padding needed
+    (1016, 512, 8, 512),    # 8*127: only degenerate divisors -> pad w/ cap
+    (2032, 512, 8, 512),    # 16*127: 16 < floor -> pad w/ cap
+    (768, 512, 128, 384),
+    (200, 512, 128, 256),   # cap clamped to round_up(seq, align)
+])
+def test_pick_aligned_block_floor(seq, preferred, align, exp):
+    assert fa._pick_aligned_block(seq, preferred, align) == exp

@@ -338,3 +338,37 @@ def test_persistent_dropped_without_close_releases_producer(tmp_path):
     del ds  # crash-style abandonment: no close() anywhere
     gc.collect()
     _assert_no_prefetch_thread(before)
+
+
+def test_close_wakes_blocked_consumer(tmp_path):
+    """close() from another thread must fail a consumer blocked waiting on
+    the next batch with a clear error, not hang it."""
+    import threading
+    import time
+    filenames = write_files(tmp_path)
+    ds = jd.JaxShufflingDataset(
+        filenames, num_epochs=1, num_trainers=1, batch_size=16, rank=0,
+        feature_columns=["emb_1"], feature_types=[np.int32],
+        label_column="labels", num_reducers=2, seed=0,
+        queue_name="jax-close-wake", prefetch_size=1)
+    ds.set_epoch(0)
+    it = iter(ds)
+    next(it)
+    errors = []
+    consumed = []
+
+    def consume_rest():
+        try:
+            for _ in it:
+                consumed.append(1)
+                time.sleep(0.05)  # slow consumer: queue stays behind us
+        except RuntimeError as e:
+            errors.append(e)
+
+    t = threading.Thread(target=consume_rest)
+    t.start()
+    time.sleep(0.15)
+    ds.close()
+    t.join(timeout=10)
+    assert not t.is_alive(), "consumer hung after close()"
+    assert errors and "closed" in str(errors[0])

@@ -1,0 +1,125 @@
+"""Disk spill tier (spill.py): over-budget reducer outputs round-trip
+through Arrow IPC files with identical results."""
+
+import gc
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ray_shuffling_data_loader_tpu import multiqueue as mq
+from ray_shuffling_data_loader_tpu import native
+from ray_shuffling_data_loader_tpu import spill as spill_mod
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+
+
+@pytest.fixture(autouse=True)
+def fresh_registry():
+    mq._REGISTRY.clear()
+    yield
+    mq._REGISTRY.clear()
+    gc.collect()
+
+
+def write_files(tmp_path, num_files=2, rows_per_file=256):
+    filenames = []
+    for i in range(num_files):
+        n = rows_per_file
+        rng = np.random.default_rng(i)
+        table = pa.table({
+            "key": pa.array(range(i * n, i * n + n), type=pa.int64()),
+            "x": pa.array(rng.integers(0, 100, n), type=pa.int64()),
+        })
+        path = str(tmp_path / f"input_{i}.parquet")
+        pq.write_table(table, path)
+        filenames.append(path)
+    return filenames
+
+
+def test_spilled_table_roundtrip(tmp_path):
+    table = pa.table({"a": np.arange(100, dtype=np.int64),
+                      "b": np.random.default_rng(0).random(100)})
+    mgr = spill_mod.SpillManager(str(tmp_path), over_budget=lambda: True)
+    handle = mgr.maybe_spill(table)
+    assert isinstance(handle, spill_mod.SpilledTable)
+    assert handle.num_rows == 100
+    assert mgr.spill_count == 1 and mgr.spilled_bytes > 0
+    loaded = handle.load()
+    assert loaded.equals(table)
+    assert handle.load() is loaded  # idempotent
+    mgr.report()
+
+
+def test_no_spill_under_budget(tmp_path):
+    table = pa.table({"a": np.arange(10, dtype=np.int64)})
+    mgr = spill_mod.SpillManager(str(tmp_path), over_budget=lambda: False)
+    assert mgr.maybe_spill(table) is table
+    assert mgr.spill_count == 0
+
+
+def test_unwrap_passthrough():
+    table = pa.table({"a": [1, 2]})
+    assert spill_mod.unwrap(table) is table
+
+
+def test_shuffle_with_spill_is_bit_identical(tmp_path):
+    """A tiny budget + spill_dir must spill (not throttle) and produce the
+    same epochs as the in-memory path."""
+    filenames = write_files(tmp_path)
+    spill_dir = str(tmp_path / "spill")
+
+    def run(spill):
+        mq._REGISTRY.clear()
+        kw = dict(max_inflight_bytes=64, spill_dir=spill_dir) if spill else {}
+        ds = ShufflingDataset(
+            filenames, num_epochs=2, num_trainers=1, batch_size=64, rank=0,
+            num_reducers=2, max_concurrent_epochs=2, seed=0,
+            queue_name=f"spill-{spill}", file_cache=None, **kw)
+        epochs = []
+        for epoch in range(2):
+            ds.set_epoch(epoch)
+            keys = [k for b in ds for k in b.column("key").to_pylist()]
+            assert sorted(keys) == list(range(512)), f"epoch {epoch}"
+            epochs.append(keys)
+        return epochs
+
+    spilled = run(spill=True)
+    plain = run(spill=False)
+    assert spilled == plain
+    # Scratch dir cleaned up after the shuffle driver finishes.
+    leftovers = [os.path.join(r, f) for r, _, fs in os.walk(spill_dir)
+                 for f in fs]
+    assert not leftovers, leftovers
+
+
+def test_spill_files_removed_after_load(tmp_path):
+    mgr = spill_mod.SpillManager(str(tmp_path), over_budget=lambda: True)
+    table = pa.table({"a": np.arange(50, dtype=np.int64)})
+    handle = mgr.maybe_spill(table)
+    files = [f for r, _, fs in os.walk(str(tmp_path)) for f in fs]
+    assert files, "nothing was written"
+    handle.load()
+    files = [f for r, _, fs in os.walk(str(tmp_path)) for f in fs]
+    assert not files, files
+    # Scratch dir itself goes when manager + handles are gone.
+    del handle
+    del mgr
+    gc.collect()
+    assert not os.listdir(str(tmp_path))
+
+
+def test_spilled_load_accounts_to_ledger(tmp_path):
+    ledger = native.buffer_ledger()
+    mgr = spill_mod.SpillManager(str(tmp_path), over_budget=lambda: True)
+    table = pa.table({"a": np.arange(1000, dtype=np.int64)})
+    handle = mgr.maybe_spill(table)
+    del table
+    gc.collect()
+    base = ledger.bytes_in_use()
+    loaded = handle.load()
+    assert ledger.bytes_in_use() >= base + 8000
+    del loaded, handle
+    gc.collect()
+    assert ledger.bytes_in_use() == base
