@@ -312,14 +312,15 @@ class ShufflingDataset:
             # In-process queues carry TaskRefs; remote queue clients
             # (multiqueue_service.py) deliver materialized tables. A
             # budget-spilled reducer output arrives as a lazy handle and
-            # is memory-mapped back here (spill.py).
-            table: pa.Table = (ref.result() if hasattr(ref, "result")
-                               else ref)
-            table = spill.unwrap(table)
+            # is memory-mapped back here (spill.py) — but only if any of
+            # it survives the resume skip: a fully-skipped handle is
+            # dropped unloaded (its finalizer unlinks the file).
+            raw = ref.result() if hasattr(ref, "result") else ref
+            if to_skip and raw.num_rows <= to_skip:
+                to_skip -= raw.num_rows
+                continue
+            table: pa.Table = spill.unwrap(raw)
             if to_skip:
-                if table.num_rows <= to_skip:
-                    to_skip -= table.num_rows
-                    continue
                 table = table.slice(to_skip)
                 to_skip = 0
             offset = 0

@@ -562,6 +562,10 @@ def shuffle(filenames: Sequence[str],
     if spill_dir is not None and max_inflight_bytes is not None:
         from ray_shuffling_data_loader_tpu.spill import SpillManager
         spill_manager = SpillManager(spill_dir, _over_budget)
+    elif spill_dir is not None:
+        logger.warning(
+            "spill_dir=%r ignored: spilling triggers on the transient-byte "
+            "budget, and max_inflight_bytes is not set", spill_dir)
 
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}

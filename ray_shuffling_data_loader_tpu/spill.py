@@ -84,7 +84,8 @@ class SpillManager:
     teardown happens after the last consumer, not when the driver exits.
     """
 
-    def __init__(self, spill_dir: str, over_budget: Callable[[], bool]):
+    def __init__(self, spill_dir: str,
+                 over_budget: Optional[Callable[[], bool]]):
         os.makedirs(spill_dir, exist_ok=True)
         self._dir = tempfile.mkdtemp(prefix="rsdl-spill-", dir=spill_dir)
         self._over_budget = over_budget
@@ -97,7 +98,8 @@ class SpillManager:
     def maybe_spill(self, table: pa.Table):
         """Spill ``table`` if the pipeline is over its transient budget;
         returns the table itself or a :class:`SpilledTable` handle."""
-        if table.num_rows == 0 or not self._over_budget():
+        if (table.num_rows == 0 or self._over_budget is None
+                or not self._over_budget()):
             return table
         with self._lock:
             path = os.path.join(self._dir, f"reduce_{self._seq}.arrow")
@@ -112,12 +114,19 @@ class SpillManager:
         return SpilledTable(path, table.num_rows, self)
 
     def report(self) -> None:
-        """Log spill totals (called when the shuffle driver finishes; the
-        scratch dir itself is removed by the finalizer once the last
-        outstanding :class:`SpilledTable` is consumed or dropped)."""
+        """Log spill totals and detach the budget predicate.
+
+        Called when the shuffle driver finishes. Dropping the predicate
+        matters: it closes over the driver's FileTableCache, and every
+        outstanding :class:`SpilledTable` pins this manager for scratch-dir
+        lifetime — without the detach, one undrained spilled batch would
+        keep the whole decoded-file cache in memory. The scratch dir
+        itself is removed by the finalizer once the last handle is gone.
+        """
         if self.spill_count:
             logger.info("spilled %d reducer outputs (%.1f MB) to disk",
                         self.spill_count, self.spilled_bytes / 1e6)
+        self._over_budget = None
 
 
 def unwrap(table_or_handle):

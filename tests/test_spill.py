@@ -123,3 +123,48 @@ def test_spilled_load_accounts_to_ledger(tmp_path):
     del loaded, handle
     gc.collect()
     assert ledger.bytes_in_use() == base
+
+
+def test_skip_drops_spilled_handles_unloaded(tmp_path):
+    """Checkpoint-resume skip must not disk-load fully-skipped spilled
+    batches (SpilledTable.num_rows decides without loading)."""
+    filenames = write_files(tmp_path)
+    spill_dir = str(tmp_path / "spill")
+    loads = []
+    orig = spill_mod.SpilledTable.load
+
+    def counting_load(self):
+        loads.append(1)
+        return orig(self)
+
+    spill_mod.SpilledTable.load = counting_load
+    try:
+        ds = ShufflingDataset(
+            filenames, num_epochs=1, num_trainers=1, batch_size=64, rank=0,
+            num_reducers=2, max_concurrent_epochs=1, seed=0,
+            queue_name="spill-skip", file_cache=None,
+            max_inflight_bytes=64, spill_dir=spill_dir)
+        # Each reducer output is 256 rows = 4 batches; skipping 4 batches
+        # must drop the first reducer's handle without loading it.
+        ds.set_epoch(0, skip_batches=4)
+        keys = [k for b in ds for k in b.column("key").to_pylist()]
+        assert len(keys) == 512 - 4 * 64
+        assert len(loads) < 2, "fully-skipped spilled batch was loaded"
+    finally:
+        spill_mod.SpilledTable.load = orig
+
+
+def test_report_detaches_budget_predicate(tmp_path):
+    sentinel = []
+
+    def over_budget():
+        sentinel.append(1)
+        return True
+
+    mgr = spill_mod.SpillManager(str(tmp_path), over_budget)
+    table = pa.table({"a": np.arange(10, dtype=np.int64)})
+    handle = mgr.maybe_spill(table)
+    assert isinstance(handle, spill_mod.SpilledTable)
+    mgr.report()
+    assert mgr._over_budget is None  # closure (and its captures) released
+    assert mgr.maybe_spill(table) is table  # no spilling after detach
