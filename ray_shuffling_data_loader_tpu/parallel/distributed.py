@@ -146,7 +146,8 @@ def _map_task(filename: str, global_file_index: int, num_reducers: int,
 def _reduce_task(reducer_index: int, seed: int, epoch: int,
                  plan: ShardPlan, transport: TcpTransport,
                  local_map_refs: Dict[int, ex.TaskRef],
-                 stats_collector, reduce_transform=None) -> pa.Table:
+                 stats_collector, reduce_transform=None,
+                 spill_manager=None) -> pa.Table:
     """Collect this reducer's chunk from every global file, then
     concat + seeded permute (global-index RNG => topology-independent)."""
     chunks: List = []  # LazyChunk (local) or pa.Table (remote)
@@ -157,8 +158,13 @@ def _reduce_task(reducer_index: int, seed: int, epoch: int,
         else:
             payload = transport.recv(src, (epoch, reducer_index, file_index))
             chunks.append(deserialize_table(payload))
-    return sh.shuffle_reduce(reducer_index, seed, epoch, chunks,
-                             stats_collector, reduce_transform)
+    shuffled = sh.shuffle_reduce(reducer_index, seed, epoch, chunks,
+                                 stats_collector, reduce_transform)
+    from ray_shuffling_data_loader_tpu import native
+    native.account_table(shuffled)
+    if spill_manager is not None:
+        shuffled = spill_manager.maybe_spill(shuffled)
+    return shuffled
 
 
 def shuffle_epoch_distributed(epoch: int,
@@ -172,7 +178,8 @@ def shuffle_epoch_distributed(epoch: int,
                               stats_collector=None,
                               map_transform=None,
                               file_cache=None,
-                              reduce_transform=None) -> List[ex.TaskRef]:
+                              reduce_transform=None,
+                              spill_manager=None) -> List[ex.TaskRef]:
     """One epoch on this host: map local files, reduce owned reducers,
     feed local trainers. Returns refs whose completion implies every
     cross-host send of this host's chunks has finished."""
@@ -191,7 +198,8 @@ def shuffle_epoch_distributed(epoch: int,
     # dropped by the receiving transport).
     reduce_refs: Dict[int, ex.TaskRef] = {
         r: pool.submit_once(_reduce_task, r, seed, epoch, plan, transport,
-                            map_refs, stats_collector, reduce_transform)
+                            map_refs, stats_collector, reduce_transform,
+                            spill_manager)
         for r in plan.local_reducers(transport.host_id)
     }
     for local_rank, trainer in enumerate(plan.local_trainers(transport.host_id)):
@@ -219,7 +227,9 @@ def shuffle_distributed(filenames: Sequence[str],
                         file_cache="auto",
                         reduce_transform=None,
                         task_retries: int = 0,
-                        collect_stats: bool = False):
+                        collect_stats: bool = False,
+                        max_inflight_bytes=None,
+                        spill_dir=None):
     """Multi-epoch pipelined distributed shuffle driver for ONE host.
 
     Run with the same arguments on every host of the world (SPMD); hosts
@@ -231,6 +241,14 @@ def shuffle_distributed(filenames: Sequence[str],
     ``collect_stats`` — THIS host's ``TrialStats`` (its local maps/
     reduces/consumes; aggregate across hosts by summing the per-host CSVs,
     the analog of the reference's per-node stage spans).
+
+    ``max_inflight_bytes`` / ``spill_dir`` carry the single-host driver's
+    memory-budget semantics per host (see ``shuffle.shuffle``): without a
+    spill dir the budget drains older epochs before launching; with one,
+    over-budget reducer outputs spill to disk. ``batch_consumer`` then
+    receives refs that may resolve to ``spill.SpilledTable`` handles —
+    ``ShufflingDataset`` unwraps them automatically; custom consumers
+    should call ``spill.unwrap``.
     """
     from ray_shuffling_data_loader_tpu import stats as stats_mod
 
@@ -254,6 +272,11 @@ def shuffle_distributed(filenames: Sequence[str],
     if file_cache == "auto":
         file_cache = (sh.default_file_cache()
                       if num_epochs - start_epoch > 1 else None)
+
+    # Same budget semantics as the single-host driver, per host.
+    from ray_shuffling_data_loader_tpu.spill import make_budget_state
+    _over_budget, spill_manager = make_budget_state(
+        file_cache, max_inflight_bytes, spill_dir)
     start = timeit.default_timer()
     owns_pool = pool is None
     if pool is None:
@@ -263,7 +286,14 @@ def shuffle_distributed(filenames: Sequence[str],
         in_progress: Dict[int, List[ex.TaskRef]] = {}
         for epoch_idx in range(start_epoch, num_epochs):
             throttle_start = timeit.default_timer()
-            while len(in_progress) >= max_concurrent_epochs:
+            # Budget pressure without a spill tier drains older epochs
+            # before launching (single-host driver parity); with spilling
+            # the launch proceeds and over-budget outputs go to disk. No
+            # consumer-poll here: hosts must stay loosely in step, and a
+            # long local stall would back-pressure every peer's reducers.
+            while in_progress and (len(in_progress) >= max_concurrent_epochs
+                                   or (spill_manager is None
+                                       and _over_budget())):
                 oldest = min(in_progress)
                 refs = in_progress.pop(oldest)
                 ex.wait(refs, num_returns=len(refs))
@@ -278,7 +308,8 @@ def shuffle_distributed(filenames: Sequence[str],
                 epoch_idx, filenames, batch_consumer, plan, transport, pool,
                 seed, start, stats_collector=stats_collector,
                 map_transform=map_transform,
-                file_cache=file_cache, reduce_transform=reduce_transform)
+                file_cache=file_cache, reduce_transform=reduce_transform,
+                spill_manager=spill_manager)
         for epoch_idx in sorted(in_progress):
             refs = in_progress.pop(epoch_idx)
             ex.wait(refs, num_returns=len(refs))
@@ -287,6 +318,8 @@ def shuffle_distributed(filenames: Sequence[str],
     finally:
         if owns_pool:
             pool.shutdown()
+        if spill_manager is not None:
+            spill_manager.report()
     if stats_collector is not None:
         stats_collector.trial_done()
         return stats_collector.get_stats()
@@ -307,7 +340,11 @@ def create_distributed_batch_queue_and_shuffle(
         start_epoch: int = 0,
         map_transform=None,
         reduce_transform=None,
-        task_retries: int = 0) -> Tuple[mq.MultiQueue, ex.TaskRef]:
+        task_retries: int = 0,
+        file_cache="auto",
+        max_inflight_bytes: Optional[int] = None,
+        spill_dir: Optional[str] = None
+) -> Tuple[mq.MultiQueue, ex.TaskRef]:
     """Host-local queue + background distributed shuffle driver.
 
     The returned ``(batch_queue, shuffle_result)`` plug straight into
@@ -336,7 +373,8 @@ def create_distributed_batch_queue_and_shuffle(
                 num_workers=num_workers, start_epoch=start_epoch,
                 map_transform=map_transform,
                 reduce_transform=reduce_transform,
-                task_retries=task_retries)
+                task_retries=task_retries, file_cache=file_cache,
+                max_inflight_bytes=max_inflight_bytes, spill_dir=spill_dir)
         except BaseException as e:  # noqa: BLE001 - forwarded to consumers
             on_failure(e)
             raise

@@ -541,31 +541,9 @@ def shuffle(filenames: Sequence[str],
     if pool is None:
         pool = ex.Executor(num_workers=num_workers,
                            task_retries=task_retries)
-    # Budget baselines: the ledger is process-global, so measure THIS
-    # shuffle's transient footprint as growth since its own start (minus
-    # its cache's growth). Other pipelines' static usage cancels out;
-    # their concurrent growth is attributed here only approximately.
-    from ray_shuffling_data_loader_tpu import native
-    _ledger_at_start = native.buffer_ledger().bytes_in_use()
-    _cache_at_start = (file_cache.bytes_cached
-                       if isinstance(file_cache, FileTableCache) else 0)
-
-    def _over_budget() -> bool:
-        if max_inflight_bytes is None:
-            return False
-        transient = native.buffer_ledger().bytes_in_use() - _ledger_at_start
-        if isinstance(file_cache, FileTableCache):
-            transient -= file_cache.bytes_cached - _cache_at_start
-        return transient > max_inflight_bytes
-
-    spill_manager = None
-    if spill_dir is not None and max_inflight_bytes is not None:
-        from ray_shuffling_data_loader_tpu.spill import SpillManager
-        spill_manager = SpillManager(spill_dir, _over_budget)
-    elif spill_dir is not None:
-        logger.warning(
-            "spill_dir=%r ignored: spilling triggers on the transient-byte "
-            "budget, and max_inflight_bytes is not set", spill_dir)
+    from ray_shuffling_data_loader_tpu.spill import make_budget_state
+    _over_budget, spill_manager = make_budget_state(
+        file_cache, max_inflight_bytes, spill_dir)
 
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}

@@ -98,8 +98,10 @@ class SpillManager:
     def maybe_spill(self, table: pa.Table):
         """Spill ``table`` if the pipeline is over its transient budget;
         returns the table itself or a :class:`SpilledTable` handle."""
-        if (table.num_rows == 0 or self._over_budget is None
-                or not self._over_budget()):
+        # Snapshot: report() may detach the predicate concurrently (driver
+        # finishing while a caller-owned pool still runs reduce tasks).
+        over_budget = self._over_budget
+        if table.num_rows == 0 or over_budget is None or not over_budget():
             return table
         with self._lock:
             path = os.path.join(self._dir, f"reduce_{self._seq}.arrow")
@@ -134,3 +136,40 @@ def unwrap(table_or_handle):
     if isinstance(table_or_handle, SpilledTable):
         return table_or_handle.load()
     return table_or_handle
+
+
+def make_budget_state(file_cache, max_inflight_bytes: Optional[int],
+                      spill_dir: Optional[str]):
+    """``(over_budget, spill_manager_or_None)`` for a shuffle driver.
+
+    Shared by the single-host and distributed drivers so the
+    transient-bytes definition stays identical: ledger growth since THIS
+    call, minus the given file cache's growth (duck-typed via
+    ``bytes_cached``; the ledger is process-global, so other pipelines'
+    static usage cancels out and only their concurrent growth is
+    attributed here). How to react to the predicate — drain-and-poll vs
+    launch-and-spill — stays in the callers.
+    """
+    from ray_shuffling_data_loader_tpu import native
+
+    def cache_bytes() -> int:
+        return getattr(file_cache, "bytes_cached", 0)
+
+    ledger_at_start = native.buffer_ledger().bytes_in_use()
+    cache_at_start = cache_bytes()
+
+    def over_budget() -> bool:
+        if max_inflight_bytes is None:
+            return False
+        transient = native.buffer_ledger().bytes_in_use() - ledger_at_start
+        transient -= cache_bytes() - cache_at_start
+        return transient > max_inflight_bytes
+
+    manager = None
+    if spill_dir is not None and max_inflight_bytes is not None:
+        manager = SpillManager(spill_dir, over_budget)
+    elif spill_dir is not None:
+        logger.warning(
+            "spill_dir=%r ignored: spilling triggers on the transient-byte "
+            "budget, and max_inflight_bytes is not set", spill_dir)
+    return over_budget, manager

@@ -144,7 +144,10 @@ class TorchShufflingDataset(IterableDataset):
                  max_batch_queue_size: int = 0,
                  seed: int = 0,
                  num_workers: Optional[int] = None,
-                 queue_name: str = "MultiQueue"):
+                 queue_name: str = "MultiQueue",
+                 file_cache="auto",
+                 max_inflight_bytes: Optional[int] = None,
+                 spill_dir: Optional[str] = None):
         super().__init__()
         self._dataset = ShufflingDataset(
             filenames, num_epochs, num_trainers, batch_size, rank,
@@ -152,7 +155,9 @@ class TorchShufflingDataset(IterableDataset):
             max_concurrent_epochs=max_concurrent_epochs,
             batch_queue=batch_queue, shuffle_result=shuffle_result,
             max_batch_queue_size=max_batch_queue_size, seed=seed,
-            num_workers=num_workers, queue_name=queue_name)
+            num_workers=num_workers, queue_name=queue_name,
+            file_cache=file_cache, max_inflight_bytes=max_inflight_bytes,
+            spill_dir=spill_dir)
         spec = _normalize_torch_data_spec(feature_columns, feature_shapes,
                                           feature_types, label_column,
                                           label_shape, label_type)

@@ -168,3 +168,42 @@ def test_report_detaches_budget_predicate(tmp_path):
     mgr.report()
     assert mgr._over_budget is None  # closure (and its captures) released
     assert mgr.maybe_spill(table) is table  # no spilling after detach
+
+
+def test_distributed_shuffle_with_spill(tmp_path):
+    """World-2 distributed shuffle under a tiny budget spills on each host
+    and still delivers every row exactly once per epoch."""
+    import threading
+    from ray_shuffling_data_loader_tpu.parallel import distributed as dist
+    from ray_shuffling_data_loader_tpu.parallel.transport import (
+        create_local_transports)
+
+    filenames = write_files(tmp_path, num_files=4, rows_per_file=128)
+    spill_dir = str(tmp_path / "spill")
+    transports = create_local_transports(2)
+    seen = [[] for _ in range(2)]
+
+    def consumer(host):
+        def batch_consumer(rank, epoch, refs):
+            if refs is None:
+                return
+            for ref in refs:
+                table = spill_mod.unwrap(ref.result())
+                seen[host].extend(table.column("key").to_pylist())
+        return batch_consumer
+
+    def run(host):
+        dist.shuffle_distributed(
+            filenames, consumer(host), num_epochs=1, num_reducers=4,
+            transport=transports[host], max_concurrent_epochs=1, seed=0,
+            file_cache=None, num_workers=2,
+            max_inflight_bytes=64, spill_dir=spill_dir)
+
+    threads = [threading.Thread(target=run, args=(h,)) for h in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    for t_ in transports:
+        t_.close()
+    assert sorted(seen[0] + seen[1]) == list(range(512))
