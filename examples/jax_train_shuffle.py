@@ -58,6 +58,12 @@ def parse_args(argv=None):
     p.add_argument("--use-old-data", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--learning-rate", type=float, default=1e-3)
+    p.add_argument("--max-inflight-bytes", type=int, default=None,
+                   help="transient pipeline memory budget (bytes); see "
+                        "examples/memory_budget.md")
+    p.add_argument("--spill-dir", type=str, default=None,
+                   help="with --max-inflight-bytes: spill over-budget "
+                        "reducer outputs to Arrow IPC files here")
     p.add_argument("--cpu", action="store_true",
                    help="Force the CPU backend (smoke runs)")
     p.add_argument("--tiny-model", action="store_true",
@@ -132,7 +138,9 @@ def main(argv=None):
         feature_types=[np.int32] * len(dg.FEATURE_COLUMNS),
         label_column=dg.LABEL_COLUMN,
         max_concurrent_epochs=args.max_concurrent_epochs, seed=args.seed,
-        drop_last=True, queue_name=f"example-queue-{rank}")
+        drop_last=True, queue_name=f"example-queue-{rank}",
+        max_inflight_bytes=args.max_inflight_bytes,
+        spill_dir=args.spill_dir)
     transport = None
     if multi_host and os.environ.get("RSDL_HOSTS"):
         # GLOBAL shuffle: rows from any host's files can reach any trainer
@@ -159,7 +167,9 @@ def main(argv=None):
                 sorted_files, args.num_epochs,
                 num_reducers, transport,
                 max_concurrent_epochs=args.max_concurrent_epochs,
-                seed=args.seed, queue_name=dataset_kwargs["queue_name"]))
+                seed=args.seed, queue_name=dataset_kwargs["queue_name"],
+                max_inflight_bytes=args.max_inflight_bytes,
+                spill_dir=args.spill_dir))
         ds = JaxShufflingDataset(
             sorted_files, batch_queue=batch_queue,
             shuffle_result=shuffle_result,

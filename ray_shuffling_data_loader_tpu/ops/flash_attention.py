@@ -437,6 +437,29 @@ def flash_backward(q, k, v, bias, out, lse, do, block_q: int = 512,
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
+# Below this sequence length XLA's fused attention ties or beats the
+# Pallas kernels on-chip (round-3 bench_attention.py: parity at S=512,
+# flash ahead from S=1024 — 2.19x fwd+bwd at S=4096).
+FLASH_MIN_SEQ_LEN = 1024
+
+
+def auto_attention_fn(seq_len: int,
+                      block_q: int = 512,
+                      block_k: int = 512):
+    """The measured-best attention for ``seq_len`` on this backend.
+
+    Returns a flash ``attention_fn`` when running on TPU with
+    ``seq_len >= FLASH_MIN_SEQ_LEN``, else ``None`` (models' inline XLA
+    attention — which XLA fuses well at short S, and which avoids the
+    interpreter's overhead on CPU). Pass the result straight to
+    ``models/bert.py``'s ``attention_fn`` hook.
+    """
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if on_tpu and seq_len >= FLASH_MIN_SEQ_LEN:
+        return make_flash_attention_fn(block_q, block_k)
+    return None
+
+
 def make_flash_attention_fn(block_q: int = 512,
                             block_k: int = 512,
                             interpret: Optional[bool] = None):

@@ -160,11 +160,7 @@ def _reduce_task(reducer_index: int, seed: int, epoch: int,
             chunks.append(deserialize_table(payload))
     shuffled = sh.shuffle_reduce(reducer_index, seed, epoch, chunks,
                                  stats_collector, reduce_transform)
-    from ray_shuffling_data_loader_tpu import native
-    native.account_table(shuffled)
-    if spill_manager is not None:
-        shuffled = spill_manager.maybe_spill(shuffled)
-    return shuffled
+    return sh.account_and_maybe_spill(shuffled, spill_manager)
 
 
 def shuffle_epoch_distributed(epoch: int,

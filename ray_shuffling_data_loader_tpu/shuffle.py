@@ -404,15 +404,20 @@ def _reduce_task(reduce_index: int, seed: int, epoch: int,
     chunks = [ref.result()[reduce_index] for ref in map_refs]
     shuffled = shuffle_reduce(reduce_index, seed, epoch, chunks,
                               stats_collector, reduce_transform)
-    # In-flight reducer bytes: charged to the buffer ledger until every
-    # consumer drops the table (plasma's store-utilization role; the
-    # max_inflight_bytes throttle in shuffle() reads the same counter).
+    return account_and_maybe_spill(shuffled, spill_manager)
+
+
+def account_and_maybe_spill(shuffled: pa.Table, spill_manager) -> pa.Table:
+    """Post-reduce memory policy, shared by the single-host and distributed
+    reduce wrappers so their semantics cannot diverge: charge the output's
+    in-flight bytes to the buffer ledger (plasma's store-utilization role;
+    the max_inflight_bytes throttle reads the same counter), then spill it
+    if a spill manager is active and the pipeline is over budget — the
+    SpilledTable handle replaces the table, so the in-memory copy is
+    released as soon as the reduce task returns."""
     from ray_shuffling_data_loader_tpu import native
     native.account_table(shuffled)
     if spill_manager is not None:
-        # Over-budget outputs go to disk; consumers reload lazily
-        # (spill.py). The SpilledTable handle replaces the table here, so
-        # the in-memory copy is released as soon as this task returns.
         shuffled = spill_manager.maybe_spill(shuffled)
     return shuffled
 

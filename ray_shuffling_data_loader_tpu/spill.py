@@ -57,10 +57,12 @@ class SpilledTable:
         weakref.finalize(self, _unlink_quiet, path)
 
     def load(self) -> pa.Table:
+        from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
         with self._lock:
             if self._table is None:
-                with pa.memory_map(self._path) as source:
-                    self._table = pa.ipc.open_file(source).read_all()
+                with trace_span("spill_load"):
+                    with pa.memory_map(self._path) as source:
+                        self._table = pa.ipc.open_file(source).read_all()
                 _unlink_quiet(self._path)
                 from ray_shuffling_data_loader_tpu import native
                 native.account_table(self._table)
@@ -103,12 +105,14 @@ class SpillManager:
         over_budget = self._over_budget
         if table.num_rows == 0 or over_budget is None or not over_budget():
             return table
+        from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
         with self._lock:
             path = os.path.join(self._dir, f"reduce_{self._seq}.arrow")
             self._seq += 1
-        with pa.OSFile(path, "wb") as sink:
-            with pa.ipc.new_file(sink, table.schema) as writer:
-                writer.write_table(table)
+        with trace_span("spill_write"):
+            with pa.OSFile(path, "wb") as sink:
+                with pa.ipc.new_file(sink, table.schema) as writer:
+                    writer.write_table(table)
         size = os.path.getsize(path)
         with self._lock:
             self.spill_count += 1

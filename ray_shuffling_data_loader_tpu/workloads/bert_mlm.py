@@ -169,11 +169,18 @@ if __name__ == "__main__":
         opt = optax.adam(1e-4)
         opt_state = opt.init(params)
 
+        # Measured-best attention for this sequence length: Pallas flash
+        # on-chip from S=1024 up, XLA's fused inline attention below.
+        from ray_shuffling_data_loader_tpu.ops.flash_attention import (
+            auto_attention_fn)
+        attention_fn = auto_attention_fn(args.seq_len)
+
         @jax.jit
         def step(params, opt_state, tokens, key):
             inputs, targets = mlm_mask(tokens, key, args.vocab_size)
             loss, grads = jax.value_and_grad(
-                lambda p: bert.loss_fn(cfg, p, inputs, targets))(params)
+                lambda p: bert.loss_fn(cfg, p, inputs, targets,
+                                       attention_fn=attention_fn))(params)
             updates, opt_state = opt.update(grads, opt_state)
             return optax.apply_updates(params, updates), opt_state, loss
 

@@ -177,3 +177,12 @@ def test_prep_bias_masks_padded_keys(rng):
 ])
 def test_pick_aligned_block_floor(seq, preferred, align, exp):
     assert fa._pick_aligned_block(seq, preferred, align) == exp
+
+
+def test_auto_attention_fn_dispatch():
+    """CPU backend -> inline (None); the TPU>=1024 branch is covered by
+    construction (make_flash_attention_fn) without needing a chip."""
+    assert fa.auto_attention_fn(4096) is None  # tests pin the cpu backend
+    assert fa.FLASH_MIN_SEQ_LEN == 1024
+    fn = fa.make_flash_attention_fn(interpret=True)
+    assert callable(fn)

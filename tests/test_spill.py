@@ -204,6 +204,7 @@ def test_distributed_shuffle_with_spill(tmp_path):
         t.start()
     for t in threads:
         t.join(timeout=60)
+        assert not t.is_alive(), "distributed shuffle hung"
     for t_ in transports:
         t_.close()
     assert sorted(seen[0] + seen[1]) == list(range(512))
