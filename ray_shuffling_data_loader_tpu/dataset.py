@@ -267,6 +267,10 @@ class ShufflingDataset:
     def start_epoch(self) -> int:
         return self._start_epoch
 
+    @property
+    def drop_last(self) -> bool:
+        return self._drop_last
+
     def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
         """Declare the epoch about to be iterated. Must be called before
         each epoch's iteration (reference: dataset.py:147-157).
@@ -285,22 +289,25 @@ class ShufflingDataset:
         self._skip_batches = skip_batches
         self._epoch = epoch
 
-    def __iter__(self) -> Iterator[pa.Table]:
+    def iter_tables(self) -> Iterator[pa.Table]:
+        """Yield this epoch's raw reducer tables (variable row counts).
+
+        Handles everything the batch iterator needs below it: the set_epoch
+        guard, the epoch's queue drain with sentinel/failure handling, the
+        ``skip_batches`` row skip (applied here as whole-table drops and one
+        zero-copy slice), and the end-of-trial shuffle join. The JAX binding
+        consumes this directly in device-rebatch mode, where batch slicing
+        happens on the accelerator instead of in Arrow.
+        """
         if self._epoch is None or self._epoch == self._last_epoch:
             raise ValueError(
                 "You must set the epoch on this dataset via set_epoch() at "
                 "the beginning of each epoch, before iterating over this "
                 "dataset (e.g. via enumerate(ds)).")
 
-        batch_size = self._batch_size
-        to_skip = self._skip_batches * batch_size  # rows, not batches
+        to_skip = self._skip_batches * self._batch_size  # rows, not batches
         self._skip_batches = 0
         queue_idx = self._epoch * self._num_trainers + self._rank
-        # Leftover carry buffer: tables whose total rows < batch_size
-        # (reference keeps a DataFrame buffer, dataset.py:170-202; we keep a
-        # list of zero-copy table slices and concat only when yielding).
-        carry: List[pa.Table] = []
-        carry_rows = 0
         while True:
             ref = self._batch_queue.get(queue_idx, block=True)
             if ref is None:
@@ -323,6 +330,24 @@ class ShufflingDataset:
             if to_skip:
                 table = table.slice(to_skip)
                 to_skip = 0
+            yield table
+        self._last_epoch = self._epoch
+        if (self._epoch == self._num_epochs - 1
+                and self._shuffle_result is not None):
+            # Join the shuffle driver (reference: dataset.py:208-210), then
+            # release the queue's name so a later trial in the same process
+            # can reuse it.
+            self._shuffle_result.result()
+            self.shutdown()
+
+    def __iter__(self) -> Iterator[pa.Table]:
+        batch_size = self._batch_size
+        # Leftover carry buffer: tables whose total rows < batch_size
+        # (reference keeps a DataFrame buffer, dataset.py:170-202; we keep a
+        # list of zero-copy table slices and concat only when yielding).
+        carry: List[pa.Table] = []
+        carry_rows = 0
+        for table in self.iter_tables():
             offset = 0
             num_rows = table.num_rows
             # Top up the carry buffer to a full batch first.
@@ -346,14 +371,6 @@ class ShufflingDataset:
                 carry_rows += num_rows - offset
         if carry_rows and not self._drop_last:
             yield pa.concat_tables(carry)
-        self._last_epoch = self._epoch
-        if (self._epoch == self._num_epochs - 1
-                and self._shuffle_result is not None):
-            # Join the shuffle driver (reference: dataset.py:208-210), then
-            # release the queue's name so a later trial in the same process
-            # can reuse it.
-            self._shuffle_result.result()
-            self.shutdown()
 
     def shutdown(self) -> None:
         """Release the named queue if this dataset created it. Idempotent.

@@ -145,10 +145,9 @@ def test_prefetch_pipeline_error_propagates(tmp_path):
         list(ds)
 
 
-def _assert_no_prefetch_thread(before_count):
+def _assert_no_prefetch_thread():
     import threading
     import time
-    del before_count  # the global count is noisy across tests; poll directly
     deadline = 100
 
     def extra():
@@ -166,7 +165,6 @@ def test_early_abandon_releases_producer(tmp_path):
     must not leak a blocked prefetch thread (regression)."""
     import threading
     filenames = write_files(tmp_path)
-    before = threading.active_count()
     ds = jd.JaxShufflingDataset(
         filenames, num_epochs=1, num_trainers=1, batch_size=16, rank=0,
         feature_columns=["emb_1"], feature_types=[np.int32],
@@ -177,7 +175,7 @@ def test_early_abandon_releases_producer(tmp_path):
     it = iter(ds)
     next(it)
     it.close()  # abandon mid-epoch
-    _assert_no_prefetch_thread(before)
+    _assert_no_prefetch_thread()
 
 
 def test_persistent_close_releases_producer(tmp_path):
@@ -186,7 +184,6 @@ def test_persistent_close_releases_producer(tmp_path):
     iterating after close() raises instead of replaying epochs."""
     import threading
     filenames = write_files(tmp_path)
-    before = threading.active_count()
     ds = jd.JaxShufflingDataset(
         filenames, num_epochs=2, num_trainers=1, batch_size=16, rank=0,
         feature_columns=["emb_1"], feature_types=[np.int32],
@@ -197,7 +194,7 @@ def test_persistent_close_releases_producer(tmp_path):
     next(it)
     it.close()  # abandon mid-epoch: producer keeps running
     ds.close()
-    _assert_no_prefetch_thread(before)
+    _assert_no_prefetch_thread()
     ds.close()  # idempotent
     ds.set_epoch(1)
     with pytest.raises(RuntimeError, match="closed"):
@@ -329,7 +326,6 @@ def test_persistent_dropped_without_close_releases_producer(tmp_path):
     import gc
     import threading
     filenames = write_files(tmp_path)
-    before = threading.active_count()
     ds = jd.JaxShufflingDataset(
         filenames, num_epochs=3, num_trainers=1, batch_size=16, rank=0,
         feature_columns=["emb_1"], feature_types=[np.int32],
@@ -341,7 +337,7 @@ def test_persistent_dropped_without_close_releases_producer(tmp_path):
     del it
     del ds  # crash-style abandonment: no close() anywhere
     gc.collect()
-    _assert_no_prefetch_thread(before)
+    _assert_no_prefetch_thread()
 
 
 def test_close_wakes_blocked_consumer(tmp_path):
@@ -376,3 +372,153 @@ def test_close_wakes_blocked_consumer(tmp_path):
     t.join(timeout=10)
     assert not t.is_alive(), "consumer hung after close()"
     assert errors and "closed" in str(errors[0])
+
+
+# -- device_rebatch (bulk table transfer + on-device slicing) --------------
+
+def _collect_batches(tmp_path, qname, device_rebatch, *, drop_last=True,
+                     skips=None, max_table_bytes=None, num_epochs=2,
+                     batch_size=48, stack=False):
+    filenames = write_files(tmp_path, num_files=3, rows_per_file=128)
+    kwargs = {}
+    if max_table_bytes is not None:
+        kwargs["max_device_table_bytes"] = max_table_bytes
+    if stack:
+        feature_columns = ["emb_1", "emb_2"]
+        feature_shapes = None
+        feature_types = [np.int32, np.int32]
+    else:
+        # include a shaped (list) column so bulk slicing covers ndim > 2
+        feature_columns = ["emb_1", "emb_2", "vec"]
+        feature_shapes = [None, None, (4,)]
+        feature_types = [np.int32, np.int32, np.float32]
+    ds = jd.JaxShufflingDataset(
+        filenames, num_epochs=num_epochs, num_trainers=1,
+        batch_size=batch_size, rank=0,
+        feature_columns=feature_columns, feature_shapes=feature_shapes,
+        feature_types=feature_types,
+        label_column="labels", num_reducers=3, seed=7,
+        queue_name=qname, drop_last=drop_last, prefetch_size=2,
+        stack_features=stack, device_rebatch=device_rebatch, **kwargs)
+    out = []
+    for epoch in range(num_epochs):
+        skip = (skips or {}).get(epoch, 0)
+        ds.set_epoch(epoch, skip_batches=skip)
+        for features, label in ds:
+            if stack:
+                out.append((np.asarray(features), np.asarray(label)))
+            else:
+                out.append((tuple(np.asarray(f) for f in features),
+                            np.asarray(label)))
+    return out
+
+
+def _assert_batches_equal(a, b):
+    assert len(a) == len(b)
+    for (fa, la), (fb, lb) in zip(a, b):
+        if isinstance(fa, tuple):
+            assert len(fa) == len(fb)
+            for x, y in zip(fa, fb):
+                np.testing.assert_array_equal(x, y)
+        else:
+            np.testing.assert_array_equal(fa, fb)
+        np.testing.assert_array_equal(la, lb)
+
+
+def test_device_rebatch_matches_host_path(tmp_path):
+    """Bulk-table mode must yield bit-identical batches in the same order
+    as per-batch host re-batching (boundary batches stitched correctly)."""
+    host = _collect_batches(tmp_path, "dr-host", device_rebatch=False)
+    dev = _collect_batches(tmp_path, "dr-dev", device_rebatch=True)
+    assert len(host) > 4  # sanity: multiple bulk tables per epoch
+    _assert_batches_equal(host, dev)
+
+
+def test_device_rebatch_tail_batch(tmp_path):
+    """drop_last=False must yield the identical ragged tail batch."""
+    host = _collect_batches(tmp_path, "drt-host", False, drop_last=False,
+                            batch_size=50)
+    dev = _collect_batches(tmp_path, "drt-dev", True, drop_last=False,
+                           batch_size=50)
+    assert host[-1][1].shape[0] != 50  # a real ragged tail exists
+    _assert_batches_equal(host, dev)
+
+
+def test_device_rebatch_skip_batches(tmp_path):
+    """skip_batches (checkpoint resume) must drop the same batches whether
+    the producer skips at the Arrow level (epoch not yet started) or the
+    consumer drops client-side (mid-flight)."""
+    skips = {0: 2, 1: 3}
+    host = _collect_batches(tmp_path, "drs-host", False, skips=skips)
+    dev = _collect_batches(tmp_path, "drs-dev", True, skips=skips)
+    _assert_batches_equal(host, dev)
+
+
+def test_device_rebatch_consumer_side_skip(tmp_path):
+    """A skip issued after the producer already ran the epoch must drop the
+    first batches of bulk tables client-side."""
+    filenames = write_files(tmp_path, num_files=2, rows_per_file=128)
+
+    def run(device_rebatch, qname):
+        ds = jd.JaxShufflingDataset(
+            filenames, num_epochs=2, num_trainers=1, batch_size=32, rank=0,
+            feature_columns=["emb_1"], feature_types=[np.int32],
+            label_column="labels", num_reducers=2, seed=3,
+            queue_name=qname, prefetch_size=1,
+            device_rebatch=device_rebatch)
+        out = []
+        ds.set_epoch(0)
+        for f, lb in ds:
+            out.append(np.asarray(lb))
+        # epoch 1 was prefetched by now; this skip goes client-side
+        import time
+        time.sleep(0.3)
+        ds.set_epoch(1, skip_batches=3)
+        for f, lb in ds:
+            out.append(np.asarray(lb))
+        return out
+
+    host = run(False, "drcs-host")
+    dev = run(True, "drcs-dev")
+    _assert_batches_equal([((), x) for x in host], [((), x) for x in dev])
+
+
+def test_device_rebatch_fat_table_fallback(tmp_path):
+    """Tables over max_device_table_bytes stream per batch — results must
+    still be identical."""
+    host = _collect_batches(tmp_path, "drf-host", False)
+    dev = _collect_batches(tmp_path, "drf-dev", True, max_table_bytes=64)
+    _assert_batches_equal(host, dev)
+
+
+def test_device_rebatch_stack_features(tmp_path):
+    host = _collect_batches(tmp_path, "drst-host", False, stack=True)
+    dev = _collect_batches(tmp_path, "drst-dev", True, stack=True)
+    _assert_batches_equal(host, dev)
+
+
+def test_device_rebatch_mesh_rejected():
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices[:1]), ("data",))
+    with pytest.raises(ValueError, match="mesh"):
+        jd.JaxShufflingDataset(
+            ["f"], num_epochs=1, num_trainers=1, batch_size=8, rank=0,
+            feature_columns=["a"], label_column="b", num_reducers=1,
+            mesh=mesh, device_rebatch=True,
+            batch_queue=object(), shuffle_result=object())
+
+
+def test_device_rebatch_repacking_spec_rejected(tmp_path):
+    """A spec that repacks the sample dimension (flat column reshaped to
+    (2,)) cannot be bulk-converted; the producer must fail loudly instead
+    of silently regrouping rows differently from the host path."""
+    filenames = write_files(tmp_path, num_files=1, rows_per_file=128)
+    ds = jd.JaxShufflingDataset(
+        filenames, num_epochs=1, num_trainers=1, batch_size=16, rank=0,
+        feature_columns=["emb_1"], feature_shapes=[(2,)],
+        feature_types=[np.int32],
+        label_column="labels", num_reducers=1, seed=0,
+        queue_name="jax-repack", device_rebatch=True)
+    ds.set_epoch(0)
+    with pytest.raises(ValueError, match="sample"):
+        list(ds)
