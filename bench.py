@@ -21,7 +21,10 @@ RSDL_BENCH_BATCH, RSDL_BENCH_PREFETCH (batches in flight, default 4),
 RSDL_BENCH_CPU=1 (force CPU backend for smoke runs),
 RSDL_BENCH_COLD=1 (disable the file-table cache so every epoch re-reads +
 re-decodes Parquet — the reference's 64 GB operating regime, where the
-corpus does not fit memory), RSDL_BENCH_DATA (data cache dir).
+corpus does not fit memory), RSDL_BENCH_DATA (data cache dir),
+RSDL_BENCH_DEVICE_REBATCH=0/1 (force the per-batch host path / the bulk
+device-rebatch path; default auto), RSDL_BENCH_STEP_MS (emulated per-batch
+train-step time for the stall%-under-load regime).
 """
 
 from __future__ import annotations
@@ -80,10 +83,13 @@ def main() -> None:
 
     num_rows = int(os.environ.get("RSDL_BENCH_ROWS", 2_000_000))
     num_files = int(os.environ.get("RSDL_BENCH_FILES", 8))
-    # 4 epochs, first excluded as warm-up: with max_concurrent_epochs=2 the
-    # timed window includes steady-state shuffle work, not just draining
-    # pre-shuffled queues.
-    num_epochs = int(os.environ.get("RSDL_BENCH_EPOCHS", 4))
+    # 8 epochs, first excluded as warm-up. The warm-up epoch's long compile
+    # lets the pipeline legitimately pre-shuffle + pre-transfer up to
+    # ~2 epochs of runway (max_concurrent_epochs + prefetch depth); with
+    # only a few timed epochs that shading inflates the rate, so the timed
+    # window is 7 epochs — long enough that steady-state shuffle work
+    # dominates what it measures.
+    num_epochs = int(os.environ.get("RSDL_BENCH_EPOCHS", 8))
     # 131072-row batches measured fastest on-chip (round 3 sweep: 65k ->
     # 17.8M rows/s, 131k -> 23.1M, 262k -> 20.7M): fewer per-batch tunnel
     # dispatches without outgrowing the transfer pipeline.
@@ -127,13 +133,19 @@ def main() -> None:
     # measures the steady state where the working set fits host memory.
     cold = bool(os.environ.get("RSDL_BENCH_COLD"))
 
+    # RSDL_BENCH_DEVICE_REBATCH=0 forces the per-batch host path for
+    # apples-to-apples comparisons of the bulk-chunk transfer design.
+    rebatch_env = os.environ.get("RSDL_BENCH_DEVICE_REBATCH", "").strip()
+    device_rebatch = "auto" if rebatch_env == "" \
+        else rebatch_env not in ("0", "false", "False")
     ds = JaxShufflingDataset(
         filenames, num_epochs=num_epochs, num_trainers=1,
         batch_size=batch_size, rank=0,
         num_reducers=num_reducers, max_concurrent_epochs=2, seed=0,
         queue_name="bench-queue", drop_last=True,
         prefetch_size=prefetch_size,
-        file_cache=None if cold else "auto", **dlrm_spec())
+        file_cache=None if cold else "auto",
+        device_rebatch=device_rebatch, **dlrm_spec())
 
     # Tiny jitted reduction per batch: forces the batch to land on device;
     # negligible compute (sparse-feature columns arrive as one pytree
@@ -216,6 +228,11 @@ def main() -> None:
         # the files (it is single-process and O(minutes) on the full set).
         "baseline_files_fraction": round(len(baseline_files) /
                                          len(filenames), 3),
+        # Hardware context: the shuffle is host-CPU work, so rows/s scales
+        # with cores; cross-round comparisons need this. (Round-1's 17.2M
+        # was a many-core host; a 1-core host sustains ~4M.)
+        "host_cpus": os.cpu_count(),
+        "timed_epochs": num_epochs - 1 if num_epochs > 1 else 1,
     }))
 
 
