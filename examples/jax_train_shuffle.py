@@ -181,9 +181,14 @@ def main(argv=None):
         # routing — no cross-host exchange, weaker global mixing).
         local_files = [f for i, f in enumerate(sorted_files)
                        if i % world == rank]
+        # A 1-device mesh needs no sharded transfers; passing mesh=None
+        # lets the dataset use device re-batching (bulk chunk transfers +
+        # on-device slicing). jit resolves the trivial sharding itself.
+        dataset_mesh = (None if multi_host or len(mesh.devices.flat) == 1
+                        else mesh)
         ds = JaxShufflingDataset(
             local_files, num_reducers=args.num_reducers,
-            mesh=None if multi_host else mesh,
+            mesh=dataset_mesh,
             device_put=not multi_host, **dataset_kwargs)
 
     import jax.numpy as jnp

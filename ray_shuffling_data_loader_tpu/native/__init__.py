@@ -73,6 +73,15 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.rsdl_buffer_bytes_in_use.restype = i64
     lib.rsdl_buffer_count.argtypes = []
     lib.rsdl_buffer_count.restype = i64
+    lib.rsdl_frame_send.argtypes = [ctypes.c_int, ctypes.c_void_p, i64,
+                                    ctypes.c_void_p, i64]
+    lib.rsdl_frame_send.restype = ctypes.c_int
+    lib.rsdl_read_exact.argtypes = [ctypes.c_int, ctypes.c_void_p, i64]
+    lib.rsdl_read_exact.restype = i64
+    lib.rsdl_buffer_trim_freelist.argtypes = []
+    lib.rsdl_buffer_trim_freelist.restype = None
+    lib.rsdl_buffer_freelist_bytes.argtypes = []
+    lib.rsdl_buffer_freelist_bytes.restype = i64
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -279,6 +288,18 @@ class NativeBufferPool:
         assert lib is not None
         return lib.rsdl_buffer_count()
 
+    def freelist_bytes(self) -> int:
+        """Bytes held in the exact-size reuse cache (not in use)."""
+        lib = _load()
+        assert lib is not None
+        return lib.rsdl_buffer_freelist_bytes()
+
+    def trim_freelist(self) -> None:
+        """Release every cached free-list block back to the OS."""
+        lib = _load()
+        assert lib is not None
+        lib.rsdl_buffer_trim_freelist()
+
 
 class PythonBufferLedger:
     """Pure-Python fallback with NativeBufferPool's accounting API, used
@@ -345,6 +366,13 @@ class PythonBufferLedger:
         with self._lock:
             return len(self._entries)
 
+    def freelist_bytes(self) -> int:
+        """Always 0: numpy's allocator does its own recycling."""
+        return 0
+
+    def trim_freelist(self) -> None:
+        pass
+
 
 _py_ledger: Optional[PythonBufferLedger] = None
 _py_ledger_lock = threading.Lock()
@@ -377,6 +405,40 @@ def account_table(table) -> None:
     ledger = buffer_ledger()
     buf_id = ledger.register(nbytes)
     weakref.finalize(table, ledger.decref, buf_id)
+
+
+def frame_send(fd: int, header, payload) -> None:
+    """Send a framed message (header then payload) as one scatter-gather
+    ``writev`` stream, entirely outside the GIL. ``header``/``payload`` are
+    any contiguous buffer-protocol objects. Raises OSError on socket errors
+    (callers treat it like a failed ``sendall``)."""
+    lib = _load()
+    assert lib is not None
+    h = np.frombuffer(header, dtype=np.uint8)
+    p = np.frombuffer(payload, dtype=np.uint8)
+    rc = lib.rsdl_frame_send(fd, h.ctypes.data, h.nbytes, p.ctypes.data,
+                             p.nbytes)
+    if rc != 0:
+        raise OSError(-rc, os.strerror(-rc))
+
+
+def read_exact_into(fd: int, buf: np.ndarray, n: int) -> bool:
+    """Read exactly ``n`` bytes from ``fd`` into ``buf`` with one GIL-free
+    call. Returns True on success, False on clean EOF before the first
+    byte; raises OSError on socket errors or mid-message EOF."""
+    import errno as _errno
+    lib = _load()
+    assert lib is not None
+    assert buf.nbytes >= n and buf.flags.c_contiguous
+    got = lib.rsdl_read_exact(fd, buf.ctypes.data, n)
+    if got == n:
+        return True
+    if got == 0:
+        return False
+    err = -got
+    if err == _errno.EPIPE:
+        raise OSError(err, "peer closed connection mid-message")
+    raise OSError(err, os.strerror(err))
 
 
 def alloc_tracked_buffer(size: int) -> np.ndarray:

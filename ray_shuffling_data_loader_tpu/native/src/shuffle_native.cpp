@@ -16,6 +16,7 @@
 // Exposed with a plain C ABI and loaded from Python via ctypes.
 
 #include <atomic>
+#include <cerrno>
 #include <cstdint>
 #include <cstdlib>
 #include <cstring>
@@ -23,6 +24,9 @@
 #include <thread>
 #include <unordered_map>
 #include <vector>
+
+#include <sys/uio.h>
+#include <unistd.h>
 
 extern "C" {
 
@@ -201,9 +205,12 @@ namespace {
 
 struct Buffer {
   void* data;
-  int64_t size;
+  int64_t size;        // bytes requested (what the ledger accounts)
+  int64_t alloc_size;  // bytes actually reserved (the size class; 0 for
+                       // register()-only entries with no memory)
   std::atomic<int64_t> refcount;
-  Buffer(void* d, int64_t s) : data(d), size(s), refcount(1) {}
+  Buffer(void* d, int64_t s, int64_t a)
+      : data(d), size(s), alloc_size(a), refcount(1) {}
 };
 
 std::mutex g_pool_mutex;
@@ -211,20 +218,77 @@ std::unordered_map<int64_t, Buffer*> g_pool;
 int64_t g_next_id = 1;
 std::atomic<int64_t> g_bytes_in_use{0};
 
+// Free list: released allocations cached for reuse (plasma-style
+// recycling). Steady-state transport recvs allocate similar sizes over and
+// over; reusing warm pages skips both mmap and the first-touch page faults
+// of a fresh block. Blocks are reserved in power-of-two size classes so
+// near-miss sizes still recycle, and insertion over the cap evicts the
+// oldest blocks of the fattest class so a burst of stale sizes cannot pin
+// the cache forever.
+std::unordered_map<int64_t, std::vector<void*>> g_freelist;  // class -> LIFO
+int64_t g_freelist_bytes = 0;  // sum of class bytes cached
+int64_t g_freelist_cap = 256LL << 20;
+
+int64_t size_class(int64_t size) {
+  int64_t c = 4096;
+  while (c < size) c <<= 1;  // callers guard size <= 2^62, so no overflow
+  return c;
+}
+
+// Move whole classes out of the free list until it is under the cap,
+// fattest class first. Caller holds g_pool_mutex and frees the returned
+// blocks AFTER releasing it (eviction is O(evicted blocks); the scan per
+// round touches only the ~30 possible size classes).
+std::vector<void*> freelist_evict_until_under_cap() {
+  std::vector<void*> evicted;
+  while (g_freelist_bytes > g_freelist_cap && !g_freelist.empty()) {
+    auto fattest = g_freelist.begin();
+    int64_t fattest_bytes = -1;
+    for (auto it = g_freelist.begin(); it != g_freelist.end(); ++it) {
+      int64_t bytes = it->first * static_cast<int64_t>(it->second.size());
+      if (bytes > fattest_bytes) {
+        fattest = it;
+        fattest_bytes = bytes;
+      }
+    }
+    g_freelist_bytes -= fattest_bytes;
+    evicted.insert(evicted.end(), fattest->second.begin(),
+                   fattest->second.end());
+    g_freelist.erase(fattest);
+  }
+  return evicted;
+}
+
 }  // namespace
 
 // Allocate a 64-byte-aligned buffer; returns an id (0 on failure or
 // negative size).
 int64_t rsdl_buffer_alloc(int64_t size) {
-  if (size < 0) return 0;
+  // Upper bound guards size_class against shift overflow; a corrupt wire
+  // length lands here, so it must fail cleanly, not spin.
+  if (size < 0 || size > (1LL << 62)) return 0;
+  int64_t cls = size_class(size);
   void* data = nullptr;
-  if (posix_memalign(&data, 64, static_cast<size_t>(size > 0 ? size : 1)) != 0)
+  {
+    std::lock_guard<std::mutex> lock(g_pool_mutex);
+    auto it = g_freelist.find(cls);
+    if (it != g_freelist.end() && !it->second.empty()) {
+      data = it->second.back();  // LIFO: warmest pages first
+      it->second.pop_back();
+      g_freelist_bytes -= cls;
+      if (it->second.empty()) g_freelist.erase(it);
+    }
+  }
+  if (data == nullptr &&
+      posix_memalign(&data, 64, static_cast<size_t>(cls)) != 0)
     return 0;
-  auto* buf = new Buffer(data, size);
+  auto* buf = new Buffer(data, size, cls);
   std::lock_guard<std::mutex> lock(g_pool_mutex);
   int64_t id = g_next_id++;
   g_pool[id] = buf;
-  g_bytes_in_use.fetch_add(size);
+  // Charge the RESERVED bytes (the class) so the budget/spill machinery
+  // sees real RSS, not the up-to-2x-smaller requested size.
+  g_bytes_in_use.fetch_add(cls);
   return id;
 }
 
@@ -236,7 +300,7 @@ int64_t rsdl_buffer_alloc(int64_t size) {
 // through one counter, plasma-store style.
 int64_t rsdl_buffer_register(int64_t size) {
   if (size < 0) return 0;
-  auto* buf = new Buffer(nullptr, size);
+  auto* buf = new Buffer(nullptr, size, 0);
   std::lock_guard<std::mutex> lock(g_pool_mutex);
   int64_t id = g_next_id++;
   g_pool[id] = buf;
@@ -264,9 +328,12 @@ int64_t rsdl_buffer_incref(int64_t id) {
   return it->second->refcount.fetch_add(1) + 1;
 }
 
-// Decrement refcount; frees at zero. Returns new count or -1 if unknown id.
+// Decrement refcount; at zero the block moves to the free list (or is
+// freed). Returns new count or -1 if unknown id. One mutex acquisition per
+// call; evicted blocks are freed after the lock is released.
 int64_t rsdl_buffer_decref(int64_t id) {
   Buffer* to_free = nullptr;
+  std::vector<void*> evicted;
   int64_t count;
   {
     std::lock_guard<std::mutex> lock(g_pool_mutex);
@@ -276,14 +343,95 @@ int64_t rsdl_buffer_decref(int64_t id) {
     if (count == 0) {
       to_free = it->second;
       g_pool.erase(it);
-      g_bytes_in_use.fetch_sub(to_free->size);
+      // Symmetric with alloc/register: alloc entries were charged their
+      // reserved class bytes, register entries their declared size.
+      g_bytes_in_use.fetch_sub(
+          to_free->alloc_size > 0 ? to_free->alloc_size : to_free->size);
+      if (to_free->data != nullptr && to_free->alloc_size > 0) {
+        g_freelist[to_free->alloc_size].push_back(to_free->data);
+        g_freelist_bytes += to_free->alloc_size;
+        to_free->data = nullptr;  // ownership moved to the free list
+        evicted = freelist_evict_until_under_cap();
+      }
     }
   }
+  for (void* p : evicted) free(p);
   if (to_free != nullptr) {
-    free(to_free->data);
+    free(to_free->data);  // nullptr when the block was cached above
     delete to_free;
   }
   return count;
+}
+
+// Drop every cached free-list block (testing / memory-pressure hook).
+void rsdl_buffer_trim_freelist() {
+  std::lock_guard<std::mutex> lock(g_pool_mutex);
+  for (auto& entry : g_freelist)
+    for (void* p : entry.second) free(p);
+  g_freelist.clear();
+  g_freelist_bytes = 0;
+}
+
+int64_t rsdl_buffer_freelist_bytes() {
+  std::lock_guard<std::mutex> lock(g_pool_mutex);
+  return g_freelist_bytes;
+}
+
+// ---------------------------------------------------------------------------
+// Transport data pump (DCN plane)
+// ---------------------------------------------------------------------------
+//
+// The Python transport's per-message cost is dominated by GIL round-trips:
+// two sendall() calls per frame and one recv_into() per ~MB of payload.
+// These two entry points move a whole frame per C call — ctypes releases
+// the GIL for the duration, so multi-MB sends/receives run entirely
+// outside the interpreter (plasma's raylet-to-raylet object transfer role,
+// SURVEY.md §2.3).
+
+// Write header then payload as one scatter-gather stream (writev), looping
+// on partial writes and EINTR. Returns 0 on success, -errno on error.
+int rsdl_frame_send(int fd, const void* header, int64_t hlen,
+                    const void* payload, int64_t plen) {
+  struct iovec iov[2];
+  iov[0].iov_base = const_cast<void*>(header);
+  iov[0].iov_len = static_cast<size_t>(hlen);
+  iov[1].iov_base = const_cast<void*>(payload);
+  iov[1].iov_len = static_cast<size_t>(plen);
+  int iov_idx = 0;
+  while (iov_idx < 2) {
+    ssize_t wrote = writev(fd, &iov[iov_idx], 2 - iov_idx);
+    if (wrote < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    size_t w = static_cast<size_t>(wrote);
+    while (iov_idx < 2 && w >= iov[iov_idx].iov_len) {
+      w -= iov[iov_idx].iov_len;
+      ++iov_idx;
+    }
+    if (iov_idx < 2 && w > 0) {
+      iov[iov_idx].iov_base = static_cast<char*>(iov[iov_idx].iov_base) + w;
+      iov[iov_idx].iov_len -= w;
+    }
+  }
+  return 0;
+}
+
+// Read exactly n bytes into dst. Returns n on success, 0 on clean EOF
+// before the first byte, -EPIPE on EOF mid-read, -errno on error.
+int64_t rsdl_read_exact(int fd, void* dst, int64_t n) {
+  int64_t got = 0;
+  while (got < n) {
+    ssize_t r = read(fd, static_cast<char*>(dst) + got,
+                     static_cast<size_t>(n - got));
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      return -errno;
+    }
+    if (r == 0) return got == 0 ? 0 : -EPIPE;
+    got += r;
+  }
+  return got;
 }
 
 int64_t rsdl_buffer_bytes_in_use() { return g_bytes_in_use.load(); }

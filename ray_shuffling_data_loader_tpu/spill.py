@@ -159,15 +159,28 @@ def make_budget_state(file_cache, max_inflight_bytes: Optional[int],
     def cache_bytes() -> int:
         return getattr(file_cache, "bytes_cached", 0)
 
-    ledger_at_start = native.buffer_ledger().bytes_in_use()
+    _start_ledger = native.buffer_ledger()
+    ledger_at_start = (_start_ledger.bytes_in_use()
+                       + _start_ledger.freelist_bytes())
     cache_at_start = cache_bytes()
 
     def over_budget() -> bool:
         if max_inflight_bytes is None:
             return False
-        transient = native.buffer_ledger().bytes_in_use() - ledger_at_start
-        transient -= cache_bytes() - cache_at_start
-        return transient > max_inflight_bytes
+        ledger = native.buffer_ledger()
+
+        def transient() -> int:
+            # Freelist bytes are real RSS the pool is holding for reuse, so
+            # the budget must see them — but they are reclaimable, so give
+            # them back before declaring the pipeline over budget.
+            return (ledger.bytes_in_use() + ledger.freelist_bytes()
+                    - ledger_at_start - (cache_bytes() - cache_at_start))
+
+        if transient() <= max_inflight_bytes:
+            return False
+        if ledger.freelist_bytes():
+            ledger.trim_freelist()
+        return transient() > max_inflight_bytes
 
     manager = None
     if spill_dir is not None and max_inflight_bytes is not None:

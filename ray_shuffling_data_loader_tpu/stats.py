@@ -87,6 +87,9 @@ class MemorySample:
     rss_bytes: int
     pool_bytes: int
     hbm_bytes: int = 0
+    # Reclaimable bytes the pool's free list holds for reuse — real RSS,
+    # but not in-flight data.
+    pool_cached_bytes: int = 0
 
     @property
     def object_store_bytes_used(self) -> int:
@@ -344,7 +347,9 @@ def get_memory_stats(sample_hbm: bool = False) -> MemorySample:
     in-flight reducer outputs, and transport recv buffers (the reference's
     plasma store-utilization columns, reference: stats.py:263-270)."""
     from ray_shuffling_data_loader_tpu import native
-    pool_bytes = native.buffer_ledger().bytes_in_use()
+    ledger = native.buffer_ledger()
+    pool_bytes = ledger.bytes_in_use()
+    pool_cached = ledger.freelist_bytes()
     hbm = 0
     if sample_hbm:
         try:
@@ -356,7 +361,8 @@ def get_memory_stats(sample_hbm: bool = False) -> MemorySample:
         except Exception:  # noqa: BLE001 - sampling must never kill a trial
             hbm = 0
     return MemorySample(timestamp=time.time(), rss_bytes=_read_rss_bytes(),
-                        pool_bytes=pool_bytes, hbm_bytes=hbm)
+                        pool_bytes=pool_bytes, hbm_bytes=hbm,
+                        pool_cached_bytes=pool_cached)
 
 
 def collect_store_stats(stats_list: List[Tuple[float, MemorySample]],

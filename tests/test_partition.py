@@ -103,7 +103,9 @@ def test_native_buffer_pool():
     pool = native.NativeBufferPool()
     before = pool.bytes_in_use()
     bid = pool.alloc(1024)
-    assert pool.bytes_in_use() == before + 1024
+    # The ledger charges RESERVED bytes: allocations round up to the
+    # 4KB-minimum power-of-two size class.
+    assert pool.bytes_in_use() == before + 4096
     view = pool.view(bid)
     view[:] = 7
     assert pool.view(bid)[123] == 7
@@ -178,3 +180,30 @@ def test_wait_duplicate_refs_rejected():
         ref = pool.submit(lambda: 1)
         with pytest.raises(ValueError):
             ex.wait([ref, ref], num_returns=2)
+
+
+def test_buffer_pool_freelist_recycles():
+    """Released pool allocations are cached for same-size-class reuse and
+    can be trimmed back to the OS; cached bytes never count as in-use."""
+    native = pytest.importorskip("ray_shuffling_data_loader_tpu.native")
+    if not native.available():
+        pytest.skip("native library unavailable")
+    import gc
+    gc.collect()  # flush other tests' pending buffer finalizers
+    pool = native.NativeBufferPool()
+    # Odd size in a class (128KB) no other test allocates concurrently.
+    size = (1 << 17) - 40
+    cls = 1 << 17
+    buf_id = pool.alloc(size)
+    in_use = pool.bytes_in_use()
+    free_before = pool.freelist_bytes()
+    pool.decref(buf_id)
+    assert pool.bytes_in_use() <= in_use - size
+    assert pool.freelist_bytes() >= free_before + cls
+    # A near-miss size in the same class reuses the cached block.
+    free_cached = pool.freelist_bytes()
+    buf_id2 = pool.alloc(size - 1000)
+    assert pool.freelist_bytes() <= free_cached - cls
+    pool.decref(buf_id2)
+    pool.trim_freelist()
+    assert pool.freelist_bytes() == 0
