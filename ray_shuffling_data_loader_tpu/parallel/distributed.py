@@ -147,7 +147,7 @@ def _reduce_task(reducer_index: int, seed: int, epoch: int,
                  plan: ShardPlan, transport: TcpTransport,
                  local_map_refs: Dict[int, ex.TaskRef],
                  stats_collector, reduce_transform=None,
-                 spill_manager=None) -> pa.Table:
+                 spill_manager=None, gather_threads=None) -> pa.Table:
     """Collect this reducer's chunk from every global file, then
     concat + seeded permute (global-index RNG => topology-independent)."""
     chunks: List = []  # LazyChunk (local) or pa.Table (remote)
@@ -159,7 +159,8 @@ def _reduce_task(reducer_index: int, seed: int, epoch: int,
             payload = transport.recv(src, (epoch, reducer_index, file_index))
             chunks.append(deserialize_table(payload))
     shuffled = sh.shuffle_reduce(reducer_index, seed, epoch, chunks,
-                                 stats_collector, reduce_transform)
+                                 stats_collector, reduce_transform,
+                                 gather_threads)
     return sh.account_and_maybe_spill(shuffled, spill_manager)
 
 
@@ -192,11 +193,14 @@ def shuffle_epoch_distributed(epoch: int,
     # retry would block on already-consumed tags until the recv timeout
     # and mask the original error. Maps MAY retry (duplicate sends are
     # dropped by the receiving transport).
+    local_reducers = plan.local_reducers(transport.host_id)
+    gather_threads = sh.derive_gather_threads(
+        len(local_reducers), pool.num_workers)
     reduce_refs: Dict[int, ex.TaskRef] = {
         r: pool.submit_once(_reduce_task, r, seed, epoch, plan, transport,
                             map_refs, stats_collector, reduce_transform,
-                            spill_manager)
-        for r in plan.local_reducers(transport.host_id)
+                            spill_manager, gather_threads)
+        for r in local_reducers
     }
     for local_rank, trainer in enumerate(plan.local_trainers(transport.host_id)):
         refs = [reduce_refs[r] for r in plan.trainer_reducers[trainer]]

@@ -64,11 +64,27 @@ MapTransform = Callable[[pa.Table], pa.Table]
 # row-order preserving; runs once per reducer per epoch.
 ReduceTransform = Callable[[pa.Table], pa.Table]
 
-# Per-call thread count for the native fused scatter-gather. Modest so that
-# concurrently-running reduce tasks (the executor's parallelism) don't
-# oversubscribe the host; on a 1-core host this is 1.
+# Fallback per-call thread count for the native fused scatter-gather when
+# no pool-aware value was derived (direct shuffle_reduce calls). Modest so
+# that concurrently-running reduce tasks don't oversubscribe the host; on a
+# 1-core host this is 1.
 import os as _os
 _SCATTER_GATHER_THREADS = max(1, min(4, (_os.cpu_count() or 1)))
+
+
+def derive_gather_threads(num_reducers: int, pool_workers: int) -> int:
+    """Threads per reduce task's fused gather, sized to the host.
+
+    The static ``min(4, cores)`` default underuses big TPU-VM hosts (a
+    100-core host running 4 reduce tasks would leave 84 cores idle in the
+    shuffle's hottest loop) and oversubscribes small ones (8 cores with 19
+    concurrent reducers at 4 threads each). Divide the cores across the
+    reduce tasks that can actually run at once (ROADMAP round-3 item:
+    reduce-stage thread tuning).
+    """
+    cores = _os.cpu_count() or 1
+    concurrent = max(1, min(num_reducers, pool_workers))
+    return max(1, min(16, cores // concurrent))
 
 # How long shuffle() polls for consumers to release tables when
 # max_inflight_bytes is exceeded before proceeding with a warning.
@@ -269,7 +285,8 @@ def shuffle_map(filename: str,
 def _fused_reduce(reduce_index: int, seed: int, epoch: int,
                   sources: Sequence[Tuple[Dict[str, np.ndarray],
                                           Optional[np.ndarray], int]],
-                  column_names: Sequence[str]) -> pa.Table:
+                  column_names: Sequence[str],
+                  gather_threads: Optional[int] = None) -> pa.Table:
     """Single-pass scatter-gather: out[i] = concat(chunks)[perm[i]].
 
     Each source is ``(columns, row_indices_or_None, num_rows)``; ``None``
@@ -307,8 +324,9 @@ def _fused_reduce(reduce_index: int, seed: int, epoch: int,
             src = cols[name]
             if (use_native and src.flags.c_contiguous
                     and dtype.itemsize in (1, 2, 4, 8)):
-                native.scatter_gather(src, idx, dest, out,
-                                      nthreads=_SCATTER_GATHER_THREADS)
+                native.scatter_gather(
+                    src, idx, dest, out,
+                    nthreads=gather_threads or _SCATTER_GATHER_THREADS)
             elif idx is None:
                 out[dest] = src
             else:
@@ -323,8 +341,8 @@ def shuffle_reduce(reduce_index: int,
                    epoch: int,
                    chunks: Sequence[Union[pa.Table, LazyChunk]],
                    stats_collector=None,
-                   reduce_transform: Optional[ReduceTransform] = None
-                   ) -> pa.Table:
+                   reduce_transform: Optional[ReduceTransform] = None,
+                   gather_threads: Optional[int] = None) -> pa.Table:
     """Concatenate one chunk per file and permute the rows
     (reference: shuffle.py:229-247).
 
@@ -340,14 +358,14 @@ def shuffle_reduce(reduce_index: int,
     start = timeit.default_timer()
     with trace_span(f"shuffle_reduce e{epoch} r{reduce_index}"):
         shuffled = _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
-                                        reduce_transform)
+                                        reduce_transform, gather_threads)
     if stats_collector is not None:
         stats_collector.reduce_done(epoch, timeit.default_timer() - start)
     return shuffled
 
 
 def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
-                         reduce_transform):
+                         reduce_transform, gather_threads=None):
     shuffled = None
     sources = []
     schema = None
@@ -371,7 +389,7 @@ def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
     else:
         if schema is not None:
             shuffled = _fused_reduce(reduce_index, seed, epoch, sources,
-                                     schema.names)
+                                     schema.names, gather_threads)
     if shuffled is None and chunks:
         # Fallback: nested / nullable / mixed-schema columns.
         tables = [
@@ -394,7 +412,8 @@ def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
 def _reduce_task(reduce_index: int, seed: int, epoch: int,
                  map_refs: Sequence[ex.TaskRef], stats_collector,
                  reduce_transform: Optional[ReduceTransform] = None,
-                 spill_manager=None) -> pa.Table:
+                 spill_manager=None,
+                 gather_threads: Optional[int] = None) -> pa.Table:
     """Executor wrapper: resolve this reducer's chunk from every map output.
 
     Equivalent of Ray resolving ``shuffle_reduce.remote(*refs)`` argument
@@ -403,7 +422,8 @@ def _reduce_task(reduce_index: int, seed: int, epoch: int,
     """
     chunks = [ref.result()[reduce_index] for ref in map_refs]
     shuffled = shuffle_reduce(reduce_index, seed, epoch, chunks,
-                              stats_collector, reduce_transform)
+                              stats_collector, reduce_transform,
+                              gather_threads)
     return account_and_maybe_spill(shuffled, spill_manager)
 
 
@@ -461,9 +481,11 @@ def shuffle_epoch(epoch: int,
                     file_index, stats_collector, map_transform, file_cache)
         for file_index, filename in enumerate(filenames)
     ]
+    gather_threads = derive_gather_threads(num_reducers, pool.num_workers)
     reduce_refs = [
         pool.submit(_reduce_task, reduce_index, seed, epoch, map_refs,
-                    stats_collector, reduce_transform, spill_manager)
+                    stats_collector, reduce_transform, spill_manager,
+                    gather_threads)
         for reduce_index in range(num_reducers)
     ]
     for trainer_idx, batches in enumerate(

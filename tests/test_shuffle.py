@@ -153,7 +153,8 @@ def test_throttle_limits_concurrency(tmp_path):
     orig_reduce = sh.shuffle_reduce
 
     def tracking_reduce(reduce_index, seed, epoch, chunks,
-                        stats_collector=None, reduce_transform=None):
+                        stats_collector=None, reduce_transform=None,
+                        gather_threads=None):
         with lock:
             active["reduces"] += 1
             active["max_overlap"] = max(active["max_overlap"],
