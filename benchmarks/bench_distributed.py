@@ -97,6 +97,50 @@ def bench_distributed_shuffle(filenames, num_epochs: int, world_size: int,
     return sum(consumed) / duration
 
 
+def bench_multi_trainer(filenames, num_epochs: int, num_trainers: int,
+                        num_reducers: int) -> float:
+    """Aggregate rows/s with ``num_trainers`` concurrent consumer ranks
+    draining their own queues of one shuffle (the reference's multi-GPU
+    topology: per-rank queue id = epoch*num_trainers+rank,
+    reference: dataset.py:173). Exercises the routing + per-rank Arrow
+    re-batching concurrently, not the device transfer."""
+    from ray_shuffling_data_loader_tpu.dataset import (
+        ShufflingDataset, create_batch_queue_and_shuffle)
+    queue, shuffle_result = create_batch_queue_and_shuffle(
+        filenames, num_epochs=num_epochs, num_trainers=num_trainers,
+        batch_size=65_536, max_concurrent_epochs=2,
+        num_reducers=num_reducers, seed=0,
+        queue_name=f"bench-mt-{num_trainers}", file_cache=None)
+    counts = [0] * num_trainers
+    errors: list = []
+
+    def consume(rank: int) -> None:
+        try:
+            ds = ShufflingDataset(
+                filenames, num_epochs=num_epochs, num_trainers=num_trainers,
+                batch_size=65_536, rank=rank, batch_queue=queue,
+                shuffle_result=shuffle_result, drop_last=False)
+            for epoch in range(num_epochs):
+                ds.set_epoch(epoch)
+                for batch in ds:
+                    counts[rank] += batch.num_rows
+        except BaseException as e:  # noqa: BLE001 - re-raised in main
+            errors.append(e)
+
+    threads = [threading.Thread(target=consume, args=(r,))
+               for r in range(num_trainers)]
+    start = timeit.default_timer()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    duration = timeit.default_timer() - start
+    queue.shutdown()  # release the name for a later run in this process
+    if errors:
+        raise errors[0]
+    return sum(counts) / duration
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rows", type=int, default=200_000)
@@ -142,6 +186,12 @@ def main() -> None:
                 num_reducers=2 * world_size)
         print(f"world={world_size}: {rows_per_s:,.0f} rows/s "
               f"({args.rows} rows x {args.epochs} epochs)")
+
+    for trainers in (2, 4):
+        rows_per_s = bench_multi_trainer(
+            filenames, args.epochs, trainers, num_reducers=4)
+        print(f"trainers={trainers}: {rows_per_s:,.0f} rows/s aggregate "
+              f"({args.rows} rows x {args.epochs} epochs, one shuffle)")
 
 
 if __name__ == "__main__":

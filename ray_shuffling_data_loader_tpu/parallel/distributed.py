@@ -176,7 +176,8 @@ def shuffle_epoch_distributed(epoch: int,
                               map_transform=None,
                               file_cache=None,
                               reduce_transform=None,
-                              spill_manager=None) -> List[ex.TaskRef]:
+                              spill_manager=None,
+                              concurrent_epochs: int = 2) -> List[ex.TaskRef]:
     """One epoch on this host: map local files, reduce owned reducers,
     feed local trainers. Returns refs whose completion implies every
     cross-host send of this host's chunks has finished."""
@@ -194,8 +195,15 @@ def shuffle_epoch_distributed(epoch: int,
     # and mask the original error. Maps MAY retry (duplicate sends are
     # dropped by the receiving transport).
     local_reducers = plan.local_reducers(transport.host_id)
+    # Loopback worlds (tests, bench_distributed, single-machine emulation)
+    # run every "host" on this one machine — split the cores; a real
+    # deployment owns its cores per host. The driver's epoch throttle keeps
+    # up to ``concurrent_epochs`` epochs' reducers in flight.
+    loopback = all(host in ("127.0.0.1", "localhost")
+                   for host, _ in transport.addresses)
     gather_threads = sh.derive_gather_threads(
-        len(local_reducers), pool.num_workers)
+        max(1, concurrent_epochs) * len(local_reducers), pool.num_workers,
+        host_share=transport.world if loopback else 1)
     reduce_refs: Dict[int, ex.TaskRef] = {
         r: pool.submit_once(_reduce_task, r, seed, epoch, plan, transport,
                             map_refs, stats_collector, reduce_transform,
@@ -309,7 +317,8 @@ def shuffle_distributed(filenames: Sequence[str],
                 seed, start, stats_collector=stats_collector,
                 map_transform=map_transform,
                 file_cache=file_cache, reduce_transform=reduce_transform,
-                spill_manager=spill_manager)
+                spill_manager=spill_manager,
+                concurrent_epochs=max_concurrent_epochs)
         for epoch_idx in sorted(in_progress):
             refs = in_progress.pop(epoch_idx)
             ex.wait(refs, num_returns=len(refs))

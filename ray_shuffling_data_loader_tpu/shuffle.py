@@ -72,7 +72,8 @@ import os as _os
 _SCATTER_GATHER_THREADS = max(1, min(4, (_os.cpu_count() or 1)))
 
 
-def derive_gather_threads(num_reducers: int, pool_workers: int) -> int:
+def derive_gather_threads(concurrent_reduces: int, pool_workers: int,
+                          host_share: int = 1) -> int:
     """Threads per reduce task's fused gather, sized to the host.
 
     The static ``min(4, cores)`` default underuses big TPU-VM hosts (a
@@ -81,9 +82,16 @@ def derive_gather_threads(num_reducers: int, pool_workers: int) -> int:
     concurrent reducers at 4 threads each). Divide the cores across the
     reduce tasks that can actually run at once (ROADMAP round-3 item:
     reduce-stage thread tuning).
+
+    ``concurrent_reduces`` is the caller's bound on simultaneously-running
+    reduce tasks — for the epoch-pipelined driver that is
+    ``num_reducers * max_concurrent_epochs``, not one epoch's worth.
+    ``host_share``: how many pipeline "hosts" share this machine (the
+    localhost multi-host emulation runs world transports in one process;
+    a real deployment owns its cores and passes 1).
     """
-    cores = _os.cpu_count() or 1
-    concurrent = max(1, min(num_reducers, pool_workers))
+    cores = (_os.cpu_count() or 1) // max(1, host_share)
+    concurrent = max(1, min(concurrent_reduces, pool_workers))
     return max(1, min(16, cores // concurrent))
 
 # How long shuffle() polls for consumers to release tables when
@@ -471,7 +479,8 @@ def shuffle_epoch(epoch: int,
                   map_transform: Optional[MapTransform] = None,
                   file_cache: Optional[FileTableCache] = None,
                   reduce_transform: Optional[ReduceTransform] = None,
-                  spill_manager=None) -> List[ex.TaskRef]:
+                  spill_manager=None,
+                  gather_threads: Optional[int] = None) -> List[ex.TaskRef]:
     """Launch one epoch's map/reduce and route outputs to trainers
     (reference: shuffle.py:163-196). Returns the reducer TaskRefs."""
     if stats_collector is not None:
@@ -481,7 +490,9 @@ def shuffle_epoch(epoch: int,
                     file_index, stats_collector, map_transform, file_cache)
         for file_index, filename in enumerate(filenames)
     ]
-    gather_threads = derive_gather_threads(num_reducers, pool.num_workers)
+    if gather_threads is None:
+        gather_threads = derive_gather_threads(num_reducers,
+                                               pool.num_workers)
     reduce_refs = [
         pool.submit(_reduce_task, reduce_index, seed, epoch, map_refs,
                     stats_collector, reduce_transform, spill_manager,
@@ -571,6 +582,11 @@ def shuffle(filenames: Sequence[str],
     from ray_shuffling_data_loader_tpu.spill import make_budget_state
     _over_budget, spill_manager = make_budget_state(
         file_cache, max_inflight_bytes, spill_dir)
+    # Epoch pipelining keeps up to max_concurrent_epochs epochs' reduce
+    # tasks in flight on this one pool — size gather threads for that
+    # total, not one epoch's worth.
+    gather_threads = derive_gather_threads(
+        num_reducers * max(1, max_concurrent_epochs), pool.num_workers)
 
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}
@@ -620,7 +636,8 @@ def shuffle(filenames: Sequence[str],
             in_progress[epoch_idx] = shuffle_epoch(
                 epoch_idx, filenames, batch_consumer, num_reducers,
                 num_trainers, pool, seed, start, stats_collector,
-                map_transform, file_cache, reduce_transform, spill_manager)
+                map_transform, file_cache, reduce_transform, spill_manager,
+                gather_threads)
         # Final drain: wait for all remaining reducer tasks
         # (reference: shuffle.py:148-151).
         for epoch_idx in sorted(in_progress):

@@ -213,3 +213,20 @@ def test_map_failure_propagates_not_hangs(tmp_path):
         sh.shuffle([str(tmp_path / "missing.parquet")], consumer,
                    num_epochs=1, num_reducers=2, num_trainers=1, seed=0,
                    collect_stats=True)
+
+
+def test_derive_gather_threads_scales_with_cores(monkeypatch):
+    """Threads per reduce gather = cores / concurrent reduce tasks,
+    clamped to [1, 16] (round-3 reduce-stage thread tuning)."""
+    monkeypatch.setattr(sh._os, "cpu_count", lambda: 96)
+    assert sh.derive_gather_threads(4, 96) == 16   # capped
+    assert sh.derive_gather_threads(12, 96) == 8
+    assert sh.derive_gather_threads(19, 96) == 5
+    # Loopback multi-host emulation splits the machine across "hosts".
+    assert sh.derive_gather_threads(4, 96, host_share=4) == 6
+    monkeypatch.setattr(sh._os, "cpu_count", lambda: 8)
+    assert sh.derive_gather_threads(19, 8) == 1    # no oversubscription
+    monkeypatch.setattr(sh._os, "cpu_count", lambda: 1)
+    assert sh.derive_gather_threads(4, 8) == 1
+    monkeypatch.setattr(sh._os, "cpu_count", lambda: None)
+    assert sh.derive_gather_threads(0, 0) == 1     # degenerate inputs
