@@ -24,7 +24,8 @@ re-decodes Parquet — the reference's 64 GB operating regime, where the
 corpus does not fit memory), RSDL_BENCH_DATA (data cache dir),
 RSDL_BENCH_DEVICE_REBATCH=0/1 (force the per-batch host path / the bulk
 device-rebatch path; default auto), RSDL_BENCH_STEP_MS (emulated per-batch
-train-step time for the stall%-under-load regime).
+train-step time for the stall%-under-load regime), RSDL_BENCH_REDUCERS
+(override the reducer count).
 """
 
 from __future__ import annotations
@@ -113,9 +114,15 @@ def main() -> None:
     device = jax.devices()[0]
     print(f"# bench device: {device}", file=sys.stderr)
 
-    # At least 4 reducers: even on small hosts, finer reducer granularity
-    # pipelines read/partition/permute stages against consumption.
-    num_reducers = max(4, default_num_reducers(num_trainers=1))
+    # At least 4 reducers (even on small hosts, finer reducer granularity
+    # pipelines read/partition/permute against consumption) — but not so
+    # many that reducer outputs shrink below ~2 batches: device re-batching
+    # moves batch-aligned spans of whole reducer outputs in bulk, and
+    # gather threads (not reducer count) now carry many-core parallelism.
+    num_reducers = int(os.environ.get(
+        "RSDL_BENCH_REDUCERS",
+        max(4, min(default_num_reducers(num_trainers=1),
+                   num_rows // (2 * batch_size)))))
 
     # Narrowest dtype per column that covers its cardinality, cast at the
     # map stage: every downstream byte — partition, permute-gather,

@@ -318,7 +318,8 @@ def shuffle_distributed(filenames: Sequence[str],
                 map_transform=map_transform,
                 file_cache=file_cache, reduce_transform=reduce_transform,
                 spill_manager=spill_manager,
-                concurrent_epochs=max_concurrent_epochs)
+                concurrent_epochs=min(max_concurrent_epochs,
+                                      num_epochs - start_epoch))
         for epoch_idx in sorted(in_progress):
             refs = in_progress.pop(epoch_idx)
             ex.wait(refs, num_returns=len(refs))

@@ -584,9 +584,10 @@ def shuffle(filenames: Sequence[str],
         file_cache, max_inflight_bytes, spill_dir)
     # Epoch pipelining keeps up to max_concurrent_epochs epochs' reduce
     # tasks in flight on this one pool — size gather threads for that
-    # total, not one epoch's worth.
+    # total, not one epoch's worth (but no more epochs than actually run).
+    overlap = max(1, min(max_concurrent_epochs, num_epochs - start_epoch))
     gather_threads = derive_gather_threads(
-        num_reducers * max(1, max_concurrent_epochs), pool.num_workers)
+        num_reducers * overlap, pool.num_workers)
 
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}
