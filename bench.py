@@ -119,10 +119,13 @@ def main() -> None:
     # many that reducer outputs shrink below ~2 batches: device re-batching
     # moves batch-aligned spans of whole reducer outputs in bulk, and
     # gather threads (not reducer count) now carry many-core parallelism.
+    # The cap wins over the floor of 4: a smoke config whose rows fit in a
+    # couple of batches gets fewer reducers rather than sub-batch outputs
+    # that would silently disable the bulk path being measured.
+    reducer_cap = max(1, num_rows // (2 * batch_size))
     num_reducers = int(os.environ.get(
         "RSDL_BENCH_REDUCERS",
-        max(4, min(default_num_reducers(num_trainers=1),
-                   num_rows // (2 * batch_size)))))
+        min(max(4, default_num_reducers(num_trainers=1)), reducer_cap)))
 
     # Narrowest dtype per column that covers its cardinality, cast at the
     # map stage: every downstream byte — partition, permute-gather,

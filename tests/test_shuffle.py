@@ -230,3 +230,25 @@ def test_derive_gather_threads_scales_with_cores(monkeypatch):
     assert sh.derive_gather_threads(4, 8) == 1
     monkeypatch.setattr(sh._os, "cpu_count", lambda: None)
     assert sh.derive_gather_threads(0, 0) == 1     # degenerate inputs
+
+
+def test_composed_shuffle_position_uniformity(tmp_path):
+    """The COMPOSED shuffle (uniform reducer assignment -> per-reducer
+    permutation -> contiguous reducer routing) must place any given key
+    approximately uniformly over output positions across seeds — the
+    statistical contract the reference's unseeded two-stage shuffle
+    provides only in expectation (reference: shuffle.py:213,240)."""
+    filenames = write_files(tmp_path, num_files=2, rows_per_file=100)
+    n, buckets, trials = 200, 4, 48
+    counts = np.zeros(buckets, dtype=int)
+    for seed in range(trials):
+        consumer = CollectingConsumer()
+        sh.shuffle(filenames, consumer, num_epochs=1, num_reducers=3,
+                   num_trainers=1, seed=seed, collect_stats=False)
+        order = consumer.epoch_keys(0, 1)
+        pos = order.index(0)  # tracked key
+        counts[pos * buckets // n] += 1
+    # Chi-square against uniform: df=3, p=0.001 critical value ~16.27.
+    expected = trials / buckets
+    chi2 = float(((counts - expected) ** 2 / expected).sum())
+    assert chi2 < 16.27, (counts.tolist(), chi2)
