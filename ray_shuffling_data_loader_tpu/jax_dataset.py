@@ -280,48 +280,81 @@ class _BatchConverter:
                 out_features = self._device_concat(out_features)
         return out_features, out_label
 
-    def transfer_table(self, arrays_label):
-        """Bulk host->device transfer of a whole (multi-batch) table.
+    def transfer_table(self, arrays_label, n_batches: int, batch_size: int):
+        """Bulk host->device transfer of a whole (multi-batch) chunk.
 
         One ``device_put`` moves every column's full span — a few ~MB
         transfers per reducer output instead of one dispatch per batch per
         column. Stacking/reshaping is deferred to :meth:`slice_batch`, which
-        runs on device. Only used when ``device_rebatch`` is active (mesh is
-        None by construction there).
+        runs on device.
+
+        Without a mesh, arrays go up as flat ``(n_batches * batch_size,
+        ...)`` spans. With a mesh, each array is reshaped host-side
+        (zero-copy) to ``(n_batches, batch_size, ...)`` and transferred with
+        the BATCH dimension (axis 1) sharded over ``data_axis`` — every
+        device receives its slice of every batch, so the per-batch carve in
+        :meth:`slice_batch` is a free axis-0 index with no resharding.
         """
         import jax
         features, label = arrays_label
         if not self._device_put:
             return features, label
-        return jax.device_put((features, label))
+        if self._mesh is None:
+            return jax.device_put((features, label))
+        from jax.sharding import NamedSharding, PartitionSpec as P
 
-    def slice_batch(self, dev_table, offset: int, batch_size: int):
-        """Carve batch ``[offset, offset+batch_size)`` out of a bulk device
-        chunk: one jitted dynamic-slice program per chunk length (the offset
-        is a traced scalar, and chunk lengths are bounded at
-        ``_MAX_CHUNK_BATCHES`` batches, so the compile set is small and
-        reused across tables and epochs), producing the same
-        ``(features, label)`` pytree the per-batch path yields. On TPU this
+        def chunked(a):
+            return a.reshape(n_batches, batch_size, *a.shape[1:])
+
+        def sharding(a):
+            return NamedSharding(
+                self._mesh, P(None, self._data_axis,
+                              *([None] * (a.ndim - 2))))
+
+        features = [chunked(f) for f in features]
+        label = chunked(label)
+        return jax.device_put(
+            (features, label),
+            ([sharding(f) for f in features], sharding(label)))
+
+    def slice_batch(self, dev_table, batch_index: int, batch_size: int):
+        """Carve batch ``batch_index`` out of a bulk device chunk: one
+        jitted program per chunk length (the index is a traced scalar, and
+        chunk lengths are bounded at ``_MAX_CHUNK_BATCHES`` batches, so the
+        compile set is small and reused across tables and epochs),
+        producing the same ``(features, label)`` pytree the per-batch path
+        yields. Flat (mesh-less) chunks dynamic-slice rows; mesh chunks are
+        ``(n_batches, batch, ...)`` with the batch axis sharded, so the
+        carve is a free axis-0 index that keeps the sharding. On TPU this
         rides HBM bandwidth; the host does no per-batch copy at all.
         """
         import jax
+        chunked = self._mesh is not None
         slicer = self._slicer.get(batch_size)
         if slicer is None:
             from jax import lax
             import jax.numpy as jnp
             stack = self._stack_features
 
-            def _slice(features, label, off):
-                fs = [lax.dynamic_slice_in_dim(f, off, batch_size, axis=0)
-                      for f in features]
+            def _slice(features, label, idx):
+                if chunked:
+                    fs = [lax.dynamic_index_in_dim(f, idx, 0, keepdims=False)
+                          for f in features]
+                    lb = lax.dynamic_index_in_dim(label, idx, 0,
+                                                  keepdims=False)
+                else:
+                    fs = [lax.dynamic_slice_in_dim(
+                              f, idx * batch_size, batch_size, axis=0)
+                          for f in features]
+                    lb = lax.dynamic_slice_in_dim(
+                        label, idx * batch_size, batch_size, axis=0)
                 if stack:
                     fs = fs[0] if len(fs) == 1 else jnp.concatenate(fs, axis=1)
-                lb = lax.dynamic_slice_in_dim(label, off, batch_size, axis=0)
                 return fs, lb
 
             slicer = self._slicer[batch_size] = jax.jit(_slice)
         features, label = dev_table
-        return slicer(features, label, np.int32(offset))
+        return slicer(features, label, np.int32(batch_index))
 
 
 def _persistent_producer(dataset: ShufflingDataset,
@@ -463,7 +496,8 @@ def _produce_epoch_tables(dataset: ShufflingDataset,
                     hi = lo + nb * bs
                     with trace_span("table_transfer"):
                         item = converter.transfer_table(
-                            ([f[lo:hi] for f in features], label[lo:hi]))
+                            ([f[lo:hi] for f in features], label[lo:hi]),
+                            nb, bs)
                     if not put(("table", epoch, (item, nb))):
                         return False
             offset += full_batches * bs
@@ -539,17 +573,21 @@ class JaxShufflingDataset:
             outputs to Arrow IPC files here instead of throttling
             (plasma's spill role; see spill.py).
         device_rebatch: move whole reducer outputs to the device in bulk
-            (one ``device_put`` per table, a few MB per column) and carve
-            batches ON DEVICE with one jitted dynamic-slice program, instead
-            of one host convert+transfer per batch. Cuts host->device
+            (one ``device_put`` per multi-batch chunk, a few MB per column)
+            and carve batches ON DEVICE with one jitted program, instead of
+            one host convert+transfer per batch. Cuts host->device
             dispatches per epoch by ~an order of magnitude — on a
             high-latency device link this is the dominant producer cost —
-            and the per-batch slice rides HBM bandwidth. Batch contents are
+            and the per-batch carve rides HBM bandwidth. Batch contents are
             identical to the host path (grid-unaligned rows at reducer
-            boundaries are stitched host-side). ``"auto"`` (default)
-            enables it when ``persistent_prefetch`` and ``device_put`` are
-            on and no mesh is given; a sharded mesh keeps the per-batch
-            path (a batch slice of a row-sharded array would reshard).
+            boundaries are stitched host-side). With a mesh, chunks are
+            reshaped host-side (zero-copy) to ``(n_batches, batch, ...)``
+            and transferred with the batch axis sharded over ``data_axis``,
+            so the carve is a free axis-0 index with no resharding —
+            requires ``batch_size`` divisible by the data-axis device
+            count. ``"auto"`` (default) enables it when
+            ``persistent_prefetch`` and ``device_put`` are on (and the
+            divisibility holds) on non-CPU backends.
         max_device_table_bytes: per-chunk byte cap for device_rebatch
             (chunks also cap at 8 batches). Aggregate input-pipeline HBM
             residency is ~``(prefetch_size + 2)`` chunks; workloads where
@@ -611,28 +649,37 @@ class JaxShufflingDataset:
         # Resolve/validate device_rebatch BEFORE constructing the underlying
         # dataset: the rank-0 path below launches the named queue and the
         # background shuffle, which must not leak if this config is invalid.
+        def _mesh_divisible():
+            if mesh is None:
+                return True
+            n_data = int(np.prod([s for n, s in zip(mesh.axis_names,
+                                                    mesh.devices.shape)
+                                  if n == data_axis] or [1]))
+            return batch_size % max(1, n_data) == 0
+
         if device_rebatch == "auto":
             # Bulk transfers need the persistent producer (the table path
             # lives there), a real device_put (otherwise there is nothing to
-            # gain and tests expect host arrays), and no mesh (a batch slice
-            # of a row-sharded array would reshard through collectives).
-            # On a CPU backend the "transfer" is a host memcpy, so bulk
-            # moves only add copies — keep the per-batch path there.
+            # gain and tests expect host arrays), and — with a mesh — a
+            # batch size divisible by the data axis (chunks transfer with
+            # the batch axis sharded). On a CPU backend the "transfer" is a
+            # host memcpy, so bulk moves only add copies — keep the
+            # per-batch path there.
             device_rebatch = (persistent_prefetch and device_put
-                              and mesh is None)
+                              and _mesh_divisible())
             if device_rebatch:
                 import jax
                 device_rebatch = jax.default_backend() != "cpu"
         elif device_rebatch:
-            if mesh is not None:
-                raise ValueError(
-                    "device_rebatch requires mesh=None: slicing a sharded "
-                    "bulk table along its sharded batch axis would trigger "
-                    "a collective per batch")
             if not persistent_prefetch or not device_put:
                 raise ValueError(
                     "device_rebatch requires persistent_prefetch=True and "
                     "device_put=True")
+            if not _mesh_divisible():
+                raise ValueError(
+                    "device_rebatch with a mesh requires batch_size "
+                    "divisible by the data-axis device count (bulk chunks "
+                    "transfer with the batch axis sharded)")
         map_transform = None
         if cast_at_map and label_column is not None:
             map_transform = make_cast_transform(
@@ -835,8 +882,7 @@ class JaxShufflingDataset:
                     for b in range(start, n_batches):
                         if b > start:
                             self.batch_wait_stats.record(0.0)
-                        yield self._converter.slice_batch(
-                            dev_table, b * bs, bs)
+                        yield self._converter.slice_batch(dev_table, b, bs)
                     continue
                 if self._consumer_skip:
                     self._consumer_skip -= 1

@@ -497,15 +497,47 @@ def test_device_rebatch_stack_features(tmp_path):
     _assert_batches_equal(host, dev)
 
 
-def test_device_rebatch_mesh_rejected():
+def test_device_rebatch_mesh_requires_divisible_batch():
     devices = jax.devices()
-    mesh = Mesh(np.array(devices[:1]), ("data",))
-    with pytest.raises(ValueError, match="mesh"):
+    mesh = Mesh(np.array(devices[:4]), ("data",))
+    with pytest.raises(ValueError, match="divisible"):
         jd.JaxShufflingDataset(
-            ["f"], num_epochs=1, num_trainers=1, batch_size=8, rank=0,
+            ["f"], num_epochs=1, num_trainers=1, batch_size=9, rank=0,
             feature_columns=["a"], label_column="b", num_reducers=1,
             mesh=mesh, device_rebatch=True,
             batch_queue=object(), shuffle_result=object())
+
+
+def test_device_rebatch_sharded_mesh_matches_host_path(tmp_path):
+    """Bulk chunks under a mesh transfer with the batch axis sharded; the
+    yielded batch stream must be value-identical to the per-batch mesh
+    path, and every batch must carry the data-axis sharding."""
+    devices = jax.devices()
+    mesh = Mesh(np.array(devices), ("data",))
+    filenames = write_files(tmp_path, num_files=3, rows_per_file=128)
+
+    def run(device_rebatch, qname):
+        ds = jd.JaxShufflingDataset(
+            filenames, num_epochs=2, num_trainers=1, batch_size=48, rank=0,
+            feature_columns=["emb_1", "emb_2"],
+            feature_types=[np.int32, np.int32],
+            label_column="labels", num_reducers=3, seed=7,
+            queue_name=qname, mesh=mesh, device_rebatch=device_rebatch)
+        out, shardings = [], []
+        for epoch in range(2):
+            ds.set_epoch(epoch)
+            for features, label in ds:
+                out.append((tuple(np.asarray(f) for f in features),
+                            np.asarray(label)))
+                shardings.append(label.sharding)
+        return out, shardings
+
+    host, _ = run(False, "drm-host")
+    dev, dev_shardings = run(True, "drm-dev")
+    _assert_batches_equal(host, dev)
+    expected = NamedSharding(mesh, P("data", None))
+    for s in dev_shardings:
+        assert s.is_equivalent_to(expected, 2)
 
 
 def test_device_rebatch_repacking_spec_rejected(tmp_path):
