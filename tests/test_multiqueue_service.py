@@ -154,3 +154,45 @@ def test_jax_dataset_over_remote_queue(tmp_parquet_dir):
         remote.close()
     shuffle_result.result()
     queue.shutdown()
+
+
+def test_jax_dataset_over_remote_queue_device_rebatch(tmp_parquet_dir):
+    """Remote-trainer topology with device re-batching forced on: tables
+    materialized over the wire flow through the bulk-chunk producer and
+    yield the same batch stream as the per-batch path."""
+    import numpy as np
+
+    from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
+
+    filenames, _ = dg.generate_data_local(160, 2, 1, 0.0, tmp_parquet_dir)
+
+    def run(device_rebatch, qname):
+        queue, shuffle_result = create_batch_queue_and_shuffle(
+            filenames, 1, num_trainers=1, batch_size=40,
+            max_concurrent_epochs=1, num_reducers=2, seed=11,
+            queue_name=qname)
+        with svc.serve_queue(queue) as server:
+            remote = svc.RemoteQueue(server.address)
+            ds = JaxShufflingDataset(
+                filenames, num_epochs=1, num_trainers=1, batch_size=40,
+                rank=0, num_reducers=2, batch_queue=remote,
+                shuffle_result=None,
+                feature_columns=list(dg.FEATURE_COLUMNS),
+                feature_types=[np.int32] * len(dg.FEATURE_COLUMNS),
+                label_column=dg.LABEL_COLUMN, drop_last=True,
+                device_rebatch=device_rebatch)
+            ds.set_epoch(0)
+            out = [(tuple(np.asarray(f) for f in feats), np.asarray(lb))
+                   for feats, lb in ds]
+            remote.close()
+        shuffle_result.result()
+        queue.shutdown()
+        return out
+
+    host = run(False, "svc-jax-drb-host")
+    dev = run(True, "svc-jax-drb-dev")
+    assert len(host) == len(dev) == 4
+    for (fa, la), (fb, lb) in zip(host, dev):
+        for x, y in zip(fa, fb):
+            np.testing.assert_array_equal(x, y)
+        np.testing.assert_array_equal(la, lb)
