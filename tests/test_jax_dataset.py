@@ -554,3 +554,15 @@ def test_device_rebatch_repacking_spec_rejected(tmp_path):
     ds.set_epoch(0)
     with pytest.raises(ValueError, match="sample"):
         list(ds)
+
+
+def test_device_rebatch_skip_with_tail(tmp_path):
+    """skip_batches combined with drop_last=False: the resumed stream must
+    keep the identical ragged tail."""
+    skips = {0: 1, 1: 4}
+    host = _collect_batches(tmp_path, "drskt-host", False, drop_last=False,
+                            batch_size=50, skips=skips)
+    dev = _collect_batches(tmp_path, "drskt-dev", True, drop_last=False,
+                           batch_size=50, skips=skips)
+    assert host[-1][1].shape[0] != 50
+    _assert_batches_equal(host, dev)
