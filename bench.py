@@ -111,7 +111,20 @@ def main() -> None:
     with open(marker) as f:
         filenames = f.read().splitlines()
 
-    device = jax.devices()[0]
+    # The tunneled TPU plugin occasionally fails its FIRST initialization
+    # if the chip is momentarily held by a dying process; a short retry
+    # turns that transient into a non-event instead of an rc=1 bench.
+    import time as _t
+    for attempt in range(3):
+        try:
+            device = jax.devices()[0]
+            break
+        except RuntimeError as e:
+            if attempt == 2:
+                raise
+            print(f"# device init failed ({e}); retrying in 10s",
+                  file=sys.stderr)
+            _t.sleep(10)
     print(f"# bench device: {device}", file=sys.stderr)
 
     # At least 4 reducers (even on small hosts, finer reducer granularity
