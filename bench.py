@@ -33,6 +33,7 @@ from __future__ import annotations
 import json
 import os
 import sys
+import time
 import timeit
 
 
@@ -112,19 +113,29 @@ def main() -> None:
         filenames = f.read().splitlines()
 
     # The tunneled TPU plugin occasionally fails its FIRST initialization
-    # if the chip is momentarily held by a dying process; a short retry
-    # turns that transient into a non-event instead of an rc=1 bench.
-    import time as _t
-    for attempt in range(3):
-        try:
-            device = jax.devices()[0]
-            break
-        except RuntimeError as e:
-            if attempt == 2:
-                raise
-            print(f"# device init failed ({e}); retrying in 10s",
-                  file=sys.stderr)
-            _t.sleep(10)
+    # if the chip is momentarily held by a dying process. An in-process
+    # retry cannot recover (jax caches the backend set after the first
+    # attempt and would silently hand back CPU — a CPU number labeled as
+    # a per-chip metric is worse than rc=1), so re-exec the whole process
+    # once: a fresh interpreter re-runs the plugin from scratch.
+    try:
+        device = jax.devices()[0]
+    except RuntimeError as e:
+        device = None
+        print(f"# device init failed: {e}", file=sys.stderr)
+    if (not os.environ.get("RSDL_BENCH_CPU")
+            and (device is None or device.platform == "cpu")):
+        if os.environ.get("RSDL_BENCH_REEXEC"):
+            raise RuntimeError(
+                "accelerator backend unavailable after re-exec; set "
+                "RSDL_BENCH_CPU=1 to benchmark on CPU deliberately")
+        print("# accelerator unavailable; re-executing once in 10s",
+              file=sys.stderr)
+        time.sleep(10)
+        os.environ["RSDL_BENCH_REEXEC"] = "1"
+        os.execv(sys.executable, [sys.executable] + sys.argv)
+    if device is None:
+        device = jax.devices()[0]
     print(f"# bench device: {device}", file=sys.stderr)
 
     # At least 4 reducers (even on small hosts, finer reducer granularity
@@ -188,7 +199,6 @@ def main() -> None:
     # stall% at a realistic step time; rows/s is then gated by the step.
     step_ms = float(os.environ.get("RSDL_BENCH_STEP_MS", 0))
 
-    import time as _time
     rows_consumed = 0
     start = timeit.default_timer()
     last = None
@@ -198,7 +208,7 @@ def main() -> None:
             for features, label in ds:
                 last = touch(features, label)
                 if step_ms:
-                    _time.sleep(step_ms / 1e3)
+                    time.sleep(step_ms / 1e3)
                 if epoch > 0 or num_epochs == 1:
                     rows_consumed += label.shape[0]
             if epoch == 0 and num_epochs > 1:

@@ -163,8 +163,12 @@ class TorchShufflingDataset(IterableDataset):
                                           label_shape, label_type)
         self._spec = spec
 
-    def set_epoch(self, epoch: int) -> None:
-        self._dataset.set_epoch(epoch)
+    def set_epoch(self, epoch: int, skip_batches: int = 0) -> None:
+        """Declare the epoch about to be iterated. ``skip_batches`` drops
+        the first N batches as zero-copy Arrow slices — checkpoint resume
+        for migrated trainers (possible here because the shuffle is seeded;
+        the reference's unseeded epochs are not replayable)."""
+        self._dataset.set_epoch(epoch, skip_batches=skip_batches)
 
     def __iter__(self):
         for table in self._dataset:

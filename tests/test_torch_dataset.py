@@ -72,3 +72,33 @@ def test_unsupported_torch_dtype_rejected_early():
         td._normalize_torch_data_spec(
             feature_columns=["a"], feature_types=[torch.bfloat16],
             label_column="y")
+
+
+def test_torch_set_epoch_skip_batches_resume(tmp_path):
+    """skip_batches through the Torch binding: the resumed tensor stream
+    matches the tail of an uninterrupted run (checkpoint-resume parity for
+    migrated trainers)."""
+    rng = np.random.default_rng(3)
+    filenames = []
+    for i in range(2):
+        path = str(tmp_path / f"in_{i}.parquet")
+        pq.write_table(pa.table({
+            "emb_1": pa.array(rng.integers(0, 50, 96), type=pa.int64()),
+            "labels": pa.array(rng.random(96), type=pa.float64()),
+        }), path)
+        filenames.append(path)
+
+    def run(skip):
+        ds = td.TorchShufflingDataset(
+            filenames, num_epochs=1, num_trainers=1, batch_size=16, rank=0,
+            feature_columns=["emb_1"], feature_types=[torch.int32],
+            label_column="labels", num_reducers=2, seed=9,
+            queue_name=f"torch-skip-{skip}")
+        ds.set_epoch(0, skip_batches=skip)
+        return [label for _, label in ds]
+
+    full = run(0)
+    resumed = run(3)
+    assert len(resumed) == len(full) - 3
+    for a, b in zip(full[3:], resumed):
+        assert torch.equal(a, b)
