@@ -393,6 +393,13 @@ def buffer_ledger():
         return _py_ledger
 
 
+def trim_freelist() -> None:
+    """Release the pool's recycled (free) buffers back to the OS. Shared
+    end-of-trial hygiene for the shuffle drivers; no-op on the Python
+    ledger."""
+    buffer_ledger().trim_freelist()
+
+
 def account_table(table) -> None:
     """Charge an Arrow table's bytes to the ledger for the lifetime of its
     Python wrapper (released by GC — the wrapper is the handle every

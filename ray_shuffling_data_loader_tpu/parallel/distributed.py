@@ -330,6 +330,11 @@ def shuffle_distributed(filenames: Sequence[str],
             pool.shutdown()
         if spill_manager is not None:
             spill_manager.report()
+        if owns_pool:
+            # End-of-trial hygiene (same gating as the single-host
+            # driver): release the pool's recycled recv buffers to the OS.
+            from ray_shuffling_data_loader_tpu import native
+            native.trim_freelist()
     if stats_collector is not None:
         stats_collector.trial_done()
         return stats_collector.get_stats()

@@ -653,6 +653,14 @@ def shuffle(filenames: Sequence[str],
             # Scratch-dir deletion is reference-managed (consumers may
             # still be draining spilled batches from the queue).
             spill_manager.report()
+        if owns_pool:
+            # End-of-trial hygiene: give the pool's recycled buffers back
+            # to the OS instead of pinning up to the freelist cap between
+            # trials. Gated like pool.shutdown(): a caller-supplied pool
+            # signals deliberate cross-trial reuse, where warm buffers are
+            # the point.
+            from ray_shuffling_data_loader_tpu import native
+            native.trim_freelist()
 
     if stats_collector is not None:
         stats_collector.trial_done()
