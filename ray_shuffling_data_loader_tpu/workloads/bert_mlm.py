@@ -145,6 +145,10 @@ if __name__ == "__main__":
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--seq-len", type=int, default=128)
     parser.add_argument("--vocab-size", type=int, default=8192)
+    parser.add_argument("--hidden-dim", type=int, default=128)
+    parser.add_argument("--num-layers", type=int, default=2)
+    parser.add_argument("--num-heads", type=int, default=4)
+    parser.add_argument("--ffn-dim", type=int, default=256)
     args = parser.parse_args()
 
     import jax
@@ -162,8 +166,11 @@ if __name__ == "__main__":
             filenames, num_epochs=args.num_epochs, num_trainers=1,
             batch_size=args.batch_size, rank=0, drop_last=True,
             **bert_mlm_spec(args.seq_len))
-        cfg = bert.BertConfig(vocab_size=args.vocab_size, hidden_dim=128,
-                              num_layers=2, num_heads=4, ffn_dim=256,
+        cfg = bert.BertConfig(vocab_size=args.vocab_size,
+                              hidden_dim=args.hidden_dim,
+                              num_layers=args.num_layers,
+                              num_heads=args.num_heads,
+                              ffn_dim=args.ffn_dim,
                               max_seq_len=args.seq_len)
         params = bert.init(cfg, jax.random.key(0))
         opt = optax.adam(1e-4)
