@@ -82,3 +82,33 @@ def test_use_old_data_missing_raises(tmp_path):
             "--use-old-data", "--data-dir", str(tmp_path / "empty"),
             "--num-trials", "1",
         ])
+
+
+def test_bench_py_json_contract(tmp_path):
+    """bench.py is the driver-facing artifact: it must exit 0 and print
+    ONE parseable JSON line with the contract keys, on a tiny CPU config."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(RSDL_BENCH_CPU="1", RSDL_BENCH_ROWS="20000",
+               RSDL_BENCH_FILES="2", RSDL_BENCH_EPOCHS="2",
+               RSDL_BENCH_BATCH="2048",
+               RSDL_BENCH_DATA=str(tmp_path / "data"))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    json_lines = [l for l in proc.stdout.splitlines()
+                  if l.startswith("{")]
+    assert len(json_lines) == 1, proc.stdout
+    record = json.loads(json_lines[0])
+    for key in ("metric", "value", "unit", "vs_baseline", "stall_pct",
+                "stall_s", "cache_mode", "host_cpus", "timed_epochs"):
+        assert key in record, key
+    assert record["metric"] == "shuffle_ingest_rows_per_sec_per_chip"
+    assert record["unit"] == "rows/s"
+    assert record["value"] > 0 and record["vs_baseline"] > 0
