@@ -566,3 +566,18 @@ def test_device_rebatch_skip_with_tail(tmp_path):
                            batch_size=50, skips=skips)
     assert host[-1][1].shape[0] != 50
     _assert_batches_equal(host, dev)
+
+
+def test_device_rebatch_empty_reducer_tables(tmp_path):
+    """iter_tables can yield 0-row reducer outputs (more reducers than
+    rows) — the bulk producer must pass through them without error and
+    deliver every row exactly once."""
+    filenames = write_files(tmp_path, num_files=1, rows_per_file=6)
+    ds = jd.JaxShufflingDataset(
+        filenames, num_epochs=1, num_trainers=1, batch_size=2, rank=0,
+        feature_columns=["emb_1"], feature_types=[np.int32],
+        label_column="labels", num_reducers=16, seed=0, drop_last=False,
+        queue_name="jax-empty-reducers", device_rebatch=True)
+    ds.set_epoch(0)
+    rows = sum(int(lb.shape[0]) for _, lb in ds)
+    assert rows == 6
