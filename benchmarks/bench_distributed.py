@@ -97,6 +97,73 @@ def bench_distributed_shuffle(filenames, num_epochs: int, world_size: int,
     return sum(consumed) / duration
 
 
+def bench_process_world(filenames, num_epochs: int,
+                        world_size: int, num_reducers: int) -> float:
+    """Aggregate rows/s with one REAL OS process per simulated host.
+
+    The thread-per-host mode shares a GIL across "hosts", so its scaling
+    numbers understate what separate TPU-VM hosts would do for CPU-bound
+    stages; this mode pays real process isolation (like the reference's
+    Ray workers) and real loopback TCP between hosts. Ephemeral-port
+    reservation is bind-then-close, which is racy in principle, so one
+    failed attempt is retried with fresh ports."""
+    import json
+    import socket
+    import subprocess
+    import sys
+    import tempfile
+
+    def attempt() -> float:
+        listeners = []
+        ports = []
+        for _ in range(world_size):
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            ports.append(s.getsockname()[1])
+            listeners.append(s)
+        for s in listeners:
+            s.close()
+        ports_csv = ",".join(str(p) for p in ports)
+        worker = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "dist_bench_worker.py")
+        with tempfile.TemporaryDirectory() as out_dir:
+            manifest = os.path.join(out_dir, "files.txt")
+            with open(manifest, "w") as f:
+                f.write("\n".join(filenames))
+            procs = [
+                subprocess.Popen(
+                    [sys.executable, worker, str(h), str(world_size),
+                     ports_csv, manifest, str(num_epochs),
+                     str(num_reducers), "65536",
+                     os.path.join(out_dir, f"h{h}.json")])
+                for h in range(world_size)
+            ]
+            try:
+                for p in procs:
+                    if p.wait(timeout=600) != 0:
+                        raise RuntimeError(
+                            f"worker exited rc={p.returncode}")
+            finally:
+                # A failed/slow sibling must not leave orphans running
+                # against a deleted out_dir.
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                        p.wait()
+            rows, seconds = 0, 0.0
+            for h in range(world_size):
+                with open(os.path.join(out_dir, f"h{h}.json")) as f:
+                    rec = json.load(f)
+                rows += rec["rows"]
+                seconds = max(seconds, rec["seconds"])
+        return rows / seconds
+
+    try:
+        return attempt()
+    except RuntimeError:
+        return attempt()
+
+
 def bench_multi_trainer(filenames, num_epochs: int, num_trainers: int,
                         num_reducers: int) -> float:
     """Aggregate rows/s with ``num_trainers`` concurrent consumer ranks
@@ -192,6 +259,13 @@ def main() -> None:
             filenames, args.epochs, trainers, num_reducers=4)
         print(f"trainers={trainers}: {rows_per_s:,.0f} rows/s aggregate "
               f"({args.rows} rows x {args.epochs} epochs, one shuffle)")
+
+    for world_size in (2, 4):
+        rows_per_s = bench_process_world(
+            filenames, args.epochs, world_size,
+            num_reducers=2 * world_size)
+        print(f"process-world={world_size}: {rows_per_s:,.0f} rows/s "
+              f"aggregate (one OS process per host)")
 
 
 if __name__ == "__main__":

@@ -1,0 +1,66 @@
+"""Subprocess worker for bench_distributed --process-worlds.
+
+One real OS process per simulated TPU-VM host (the thread-per-host mode
+shares a GIL across "hosts"; this mode does not, so its scaling numbers
+are honest for CPU-bound stages). Runs the distributed shuffle, consumes
+its trainer's batches, writes {rows, seconds} JSON for the parent.
+
+Usage: python dist_bench_worker.py <host_id> <world> <ports_csv>
+       <manifest_path> <num_epochs> <num_reducers> <batch_size> <out_path>
+
+``manifest_path`` is a newline-separated file list written by the parent,
+so every mode of the benchmark runs the exact same corpus (a directory
+glob would silently pick up stale files from earlier runs with different
+--files settings).
+"""
+
+import json
+import os
+import sys
+import timeit
+
+sys.path.insert(0,
+                os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset  # noqa: E402
+from ray_shuffling_data_loader_tpu.parallel.distributed import (  # noqa: E402
+    create_distributed_batch_queue_and_shuffle)
+from ray_shuffling_data_loader_tpu.parallel.transport import TcpTransport  # noqa: E402
+
+
+def main() -> None:
+    (host_id, world, ports_csv, manifest_path, num_epochs, num_reducers,
+     batch_size, out_path) = sys.argv[1:9]
+    host_id, world = int(host_id), int(world)
+    num_epochs, num_reducers = int(num_epochs), int(num_reducers)
+    batch_size = int(batch_size)
+    addresses = [("127.0.0.1", int(p)) for p in ports_csv.split(",")]
+    with open(manifest_path) as f:
+        filenames = [line for line in f.read().splitlines() if line]
+
+    transport = TcpTransport(host_id, addresses, recv_timeout_s=120.0)
+    transport.start()
+    transport.connect()
+    rows = 0
+    start = timeit.default_timer()
+    try:
+        batch_queue, shuffle_result = (
+            create_distributed_batch_queue_and_shuffle(
+                filenames, num_epochs, num_reducers, transport,
+                max_concurrent_epochs=2, seed=0))
+        ds = ShufflingDataset(
+            filenames, num_epochs, num_trainers=1, batch_size=batch_size,
+            rank=0, batch_queue=batch_queue, shuffle_result=shuffle_result)
+        for epoch in range(num_epochs):
+            ds.set_epoch(epoch)
+            for table in ds:
+                rows += table.num_rows
+    finally:
+        transport.close()
+    with open(out_path, "w") as f:
+        json.dump({"rows": rows,
+                   "seconds": timeit.default_timer() - start}, f)
+
+
+if __name__ == "__main__":
+    main()
