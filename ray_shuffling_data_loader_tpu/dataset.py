@@ -341,36 +341,8 @@ class ShufflingDataset:
             self.shutdown()
 
     def __iter__(self) -> Iterator[pa.Table]:
-        batch_size = self._batch_size
-        # Leftover carry buffer: tables whose total rows < batch_size
-        # (reference keeps a DataFrame buffer, dataset.py:170-202; we keep a
-        # list of zero-copy table slices and concat only when yielding).
-        carry: List[pa.Table] = []
-        carry_rows = 0
-        for table in self.iter_tables():
-            offset = 0
-            num_rows = table.num_rows
-            # Top up the carry buffer to a full batch first.
-            if carry_rows:
-                need = batch_size - carry_rows
-                take = min(need, num_rows)
-                carry.append(table.slice(0, take))
-                carry_rows += take
-                offset = take
-                if carry_rows == batch_size:
-                    yield pa.concat_tables(carry)
-                    carry = []
-                    carry_rows = 0
-            # Yield full batches straight out of this table, zero-copy.
-            while num_rows - offset >= batch_size:
-                yield table.slice(offset, batch_size)
-                offset += batch_size
-            # Stash the tail.
-            if offset < num_rows:
-                carry.append(table.slice(offset))
-                carry_rows += num_rows - offset
-        if carry_rows and not self._drop_last:
-            yield pa.concat_tables(carry)
+        return slice_batches(self.iter_tables(), self._batch_size,
+                             self._drop_last)
 
     def shutdown(self) -> None:
         """Release the named queue if this dataset created it. Idempotent.
@@ -381,6 +353,44 @@ class ShufflingDataset:
         if self._owns_queue:
             self._batch_queue.shutdown()
             self._owns_queue = False
+
+
+def slice_batches(tables: Iterator[pa.Table], batch_size: int,
+                  drop_last: bool) -> Iterator[pa.Table]:
+    """Exact-size re-batching over a stream of variable-size tables.
+
+    The leftover carry buffer spans table boundaries (reference keeps a
+    DataFrame buffer, dataset.py:170-202; we keep a list of zero-copy
+    table slices and concat only when yielding). Shared by
+    ``ShufflingDataset.__iter__`` and the JAX binding's per-batch fallback
+    so their batch grids cannot diverge.
+    """
+    carry: List[pa.Table] = []
+    carry_rows = 0
+    for table in tables:
+        offset = 0
+        num_rows = table.num_rows
+        # Top up the carry buffer to a full batch first.
+        if carry_rows:
+            need = batch_size - carry_rows
+            take = min(need, num_rows)
+            carry.append(table.slice(0, take))
+            carry_rows += take
+            offset = take
+            if carry_rows == batch_size:
+                yield pa.concat_tables(carry)
+                carry = []
+                carry_rows = 0
+        # Yield full batches straight out of this table, zero-copy.
+        while num_rows - offset >= batch_size:
+            yield table.slice(offset, batch_size)
+            offset += batch_size
+        # Stash the tail.
+        if offset < num_rows:
+            carry.append(table.slice(offset))
+            carry_rows += num_rows - offset
+    if carry_rows and not drop_last:
+        yield pa.concat_tables(carry)
 
 
 if __name__ == "__main__":
