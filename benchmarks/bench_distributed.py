@@ -208,6 +208,40 @@ def bench_multi_trainer(filenames, num_epochs: int, num_trainers: int,
     return sum(counts) / duration
 
 
+def bench_served_queue(filenames, num_epochs: int, num_reducers: int,
+                       max_batch: int, prefetch: bool) -> float:
+    """rows/s for the separate-trainer-process topology: the shuffle's
+    queue is exported over TCP (QueueServer) and the consumer drains it
+    through a RemoteQueue — every reducer table crosses the process
+    boundary as Arrow IPC (the reference's Ray-actor queue + plasma fetch
+    path; its batched actor ops motivated the batched GET,
+    reference: multiqueue.py:127-154)."""
+    from ray_shuffling_data_loader_tpu import multiqueue_service as svc
+    from ray_shuffling_data_loader_tpu.dataset import (
+        ShufflingDataset, create_batch_queue_and_shuffle)
+    queue, shuffle_result = create_batch_queue_and_shuffle(
+        filenames, num_epochs=num_epochs, num_trainers=1,
+        batch_size=65_536, max_concurrent_epochs=2,
+        num_reducers=num_reducers, seed=0, queue_name=None, file_cache=None)
+    rows = 0
+    start = timeit.default_timer()
+    with svc.serve_queue(queue) as server:
+        with svc.RemoteQueue(server.address, max_batch=max_batch,
+                             prefetch=prefetch) as remote:
+            ds = ShufflingDataset(
+                filenames, num_epochs=num_epochs, num_trainers=1,
+                batch_size=65_536, rank=0, batch_queue=remote,
+                shuffle_result=None, drop_last=False)
+            for epoch in range(num_epochs):
+                ds.set_epoch(epoch)
+                for batch in ds:
+                    rows += batch.num_rows
+    duration = timeit.default_timer() - start
+    shuffle_result.result()
+    queue.shutdown()
+    return rows / duration
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rows", type=int, default=200_000)
@@ -259,6 +293,17 @@ def main() -> None:
             filenames, args.epochs, trainers, num_reducers=4)
         print(f"trainers={trainers}: {rows_per_s:,.0f} rows/s aggregate "
               f"({args.rows} rows x {args.epochs} epochs, one shuffle)")
+
+    inproc = bench_multi_trainer(filenames, args.epochs, 1, num_reducers=4)
+    print(f"served-queue baseline (in-process, 1 trainer): "
+          f"{inproc:,.0f} rows/s")
+    for max_batch, prefetch, label in ((1, False, "serial RPC"),
+                                       (8, True, "batched+prefetch")):
+        rows_per_s = bench_served_queue(
+            filenames, args.epochs, num_reducers=4,
+            max_batch=max_batch, prefetch=prefetch)
+        print(f"served-queue {label}: {rows_per_s:,.0f} rows/s "
+              f"({rows_per_s / inproc:.2f}x of in-process)")
 
     for world_size in (2, 4):
         rows_per_s = bench_process_world(

@@ -23,6 +23,7 @@ waiting for a batch (reference: ray_torch_shuffle.py:186-218) — in
 
 from __future__ import annotations
 
+import itertools
 import queue as _queue
 import threading
 import timeit
@@ -31,7 +32,8 @@ from typing import Any, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
-from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+from ray_shuffling_data_loader_tpu.dataset import (ShufflingDataset,
+                                                   slice_batches)
 from ray_shuffling_data_loader_tpu.stats import BatchWaitStats
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
@@ -210,6 +212,7 @@ class _BatchConverter:
     def __init__(self, feature_columns, feature_shapes, feature_types,
                  label_column, label_shape, label_type, stack_features,
                  mesh, data_axis, device_put, device_rebatch=False,
+                 device_rebatch_auto=False,
                  max_table_bytes=512 * 1024 * 1024):
         self._feature_columns = feature_columns
         self._feature_shapes = feature_shapes
@@ -227,6 +230,10 @@ class _BatchConverter:
         # JaxShufflingDataset docstring). These two fields configure the
         # producer's table path; the per-batch path ignores them.
         self.device_rebatch = device_rebatch
+        # True when rebatch was resolved from "auto" rather than requested
+        # explicitly: specs the bulk path can't reproduce then fall back to
+        # per-batch transfers instead of failing a previously-working job.
+        self.device_rebatch_auto = device_rebatch_auto
         self.max_table_bytes = max_table_bytes
         self._slicer = {}  # batch_size -> jitted batch slicer, built lazily
 
@@ -441,7 +448,9 @@ def _produce_epoch_tables(dataset: ShufflingDataset,
         with trace_span("batch_transfer"):
             return converter.transfer((pieces_f, pieces_l))
 
-    for table in dataset.iter_tables():
+    tables = dataset.iter_tables()
+    emitted = False  # anything put() or carried yet this epoch
+    for table in tables:
         with trace_span("table_convert"):
             features, label = converter.convert(table)
         n = table.num_rows
@@ -449,13 +458,38 @@ def _produce_epoch_tables(dataset: ShufflingDataset,
             # A spec whose reshape repacks the sample dimension (e.g. a flat
             # column with feature_shape=(4,)) groups rows differently per
             # converted span, so bulk conversion cannot reproduce the host
-            # path's per-batch grouping. Refuse loudly instead of silently
-            # diverging.
+            # path's per-batch grouping. When rebatch was an "auto" default
+            # (not explicitly requested), fall back to the per-batch
+            # convert+transfer path — same batch grid via slice_batches, so
+            # the stream is identical to the host path. Only an explicit
+            # device_rebatch=True fails loudly.
+            if converter.device_rebatch_auto and not emitted:
+                logger.warning(
+                    "device_rebatch (auto) disabled: the column spec "
+                    "repacks the sample dimension; using per-batch "
+                    "transfers")
+                # Permanent for this dataset: the spec-to-shape ratio is
+                # constant across tables and epochs, so later epochs take
+                # the per-batch path directly instead of rediscovering the
+                # mismatch (and re-logging) every epoch.
+                converter.device_rebatch = False
+                for batch_table in slice_batches(
+                        itertools.chain([table], tables), bs,
+                        dataset.drop_last):
+                    with trace_span("batch_convert"):
+                        arrays = converter.convert(batch_table)
+                    with trace_span("batch_transfer"):
+                        batch = converter.transfer(arrays)
+                    if not put(("batch", epoch, batch)):
+                        return False
+                return True
             raise ValueError(
                 "device_rebatch requires specs whose converted arrays keep "
                 "one sample per table row; a feature_shape/label_shape "
                 "repacks the sample dimension here. Construct with "
                 "device_rebatch=False for this spec.")
+        if n:
+            emitted = True
         offset = 0
         if carry_rows:
             take = min(bs - carry_rows, n)
@@ -588,11 +622,17 @@ class JaxShufflingDataset:
             count. ``"auto"`` (default) enables it when
             ``persistent_prefetch`` and ``device_put`` are on (and the
             divisibility holds) on non-CPU backends.
-        max_device_table_bytes: per-chunk byte cap for device_rebatch
-            (chunks also cap at 8 batches). Aggregate input-pipeline HBM
-            residency is ~``(prefetch_size + 2)`` chunks; workloads where
-            one batch alone exceeds the cap (fat rows — e.g. decoded
-            images) fall back to per-batch transfers.
+        max_device_input_bytes: combined HBM budget for the input
+            pipeline in device_rebatch mode. The pipeline holds at most
+            ~``(prefetch_size + 2)`` chunks on device, so the per-chunk
+            cap is derived as ``max_device_input_bytes /
+            (prefetch_size + 2)`` — raising ``prefetch_size`` shrinks
+            chunks instead of multiplying HBM residency. Default 1 GiB.
+        max_device_table_bytes: explicit per-chunk byte cap for
+            device_rebatch (chunks also cap at 8 batches); overrides the
+            derivation from ``max_device_input_bytes`` when set.
+            Workloads where one batch alone exceeds the cap (fat rows —
+            e.g. decoded images) fall back to per-batch transfers.
     """
 
     def __init__(self,
@@ -629,7 +669,8 @@ class JaxShufflingDataset:
                  max_inflight_bytes: Optional[int] = None,
                  spill_dir: Optional[str] = None,
                  device_rebatch="auto",
-                 max_device_table_bytes: int = 512 * 1024 * 1024):
+                 max_device_input_bytes: int = 1 << 30,
+                 max_device_table_bytes: Optional[int] = None):
         (self._feature_columns, self._feature_shapes, self._feature_types,
          self._label_column, self._label_shape, self._label_type) = (
              _normalize_jax_data_spec(feature_columns, feature_shapes,
@@ -657,6 +698,7 @@ class JaxShufflingDataset:
                                   if n == data_axis] or [1]))
             return batch_size % max(1, n_data) == 0
 
+        device_rebatch_auto = device_rebatch == "auto"
         if device_rebatch == "auto":
             # Bulk transfers need the persistent producer (the table path
             # lives there), a real device_put (otherwise there is nothing to
@@ -699,11 +741,18 @@ class JaxShufflingDataset:
         self._data_axis = data_axis
         self._prefetch_size = max(1, prefetch_size)
         self._device_put = device_put
+        if max_device_table_bytes is None:
+            # Keep TOTAL device-resident input bytes at the documented
+            # budget regardless of queue depth: the pipeline holds at most
+            # ~(prefetch_size + 2) chunks at once (ADVICE r3).
+            max_device_table_bytes = max(
+                1, max_device_input_bytes // (self._prefetch_size + 2))
         self._converter = _BatchConverter(
             self._feature_columns, self._feature_shapes, self._feature_types,
             self._label_column, self._label_shape, self._label_type,
             stack_features, mesh, data_axis, device_put,
             device_rebatch=bool(device_rebatch),
+            device_rebatch_auto=device_rebatch_auto,
             max_table_bytes=max_device_table_bytes)
         self.batch_wait_stats = BatchWaitStats()
         # Persistent-prefetch state (one producer thread for ALL epochs).

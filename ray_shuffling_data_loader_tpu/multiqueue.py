@@ -40,6 +40,14 @@ class Full(Exception):
     """Raised by non-blocking puts on a full queue (reference: multiqueue.py:17-18)."""
 
 
+class ShutdownError(RuntimeError):
+    """Raised to callers blocked in ``get``/``put`` when the queue shuts down.
+
+    The reference's actor kill made blocked consumers fail loudly with a
+    RayActorError (reference: multiqueue.py:285-307); this is the in-process
+    equivalent so a stray consumer thread can't be silently stranded."""
+
+
 class BoundedFifo:
     """Bounded FIFO with atomic all-or-nothing batch operations.
 
@@ -49,7 +57,8 @@ class BoundedFifo:
     :class:`Empty`/:class:`Full`.
     """
 
-    __slots__ = ("_maxsize", "_items", "_mutex", "_not_empty", "_not_full")
+    __slots__ = ("_maxsize", "_items", "_mutex", "_not_empty", "_not_full",
+                 "_closed")
 
     def __init__(self, maxsize: int = 0):
         self._maxsize = maxsize
@@ -57,6 +66,16 @@ class BoundedFifo:
         self._mutex = threading.Lock()
         self._not_empty = threading.Condition(self._mutex)
         self._not_full = threading.Condition(self._mutex)
+        self._closed = False
+
+    def close(self) -> None:
+        """Wake every blocked ``put``/``get`` waiter with :class:`ShutdownError`.
+
+        Items already enqueued remain readable via non-waiting gets."""
+        with self._mutex:
+            self._closed = True
+            self._not_empty.notify_all()
+            self._not_full.notify_all()
 
     def qsize(self) -> int:
         with self._mutex:
@@ -74,6 +93,8 @@ class BoundedFifo:
                 deadline = (None if timeout is None
                             else time.monotonic() + timeout)
                 while not self._has_room():
+                    if self._closed:
+                        raise ShutdownError("queue shut down while put blocked")
                     remaining = (None if deadline is None
                                  else deadline - time.monotonic())
                     if remaining is not None and remaining <= 0:
@@ -91,6 +112,8 @@ class BoundedFifo:
                 deadline = (None if timeout is None
                             else time.monotonic() + timeout)
                 while not self._items:
+                    if self._closed:
+                        raise ShutdownError("queue shut down while get blocked")
                     remaining = (None if deadline is None
                                  else deadline - time.monotonic())
                     if remaining is not None and remaining <= 0:
@@ -272,9 +295,13 @@ class MultiQueue:
         The graceful-then-forceful contract of the reference's actor kill
         (reference: multiqueue.py:285-307) maps to: refuse new puts
         immediately, wait up to ``grace_period_s`` for in-flight async ops,
-        then cancel whatever remains. Items already enqueued stay readable.
+        then cancel whatever remains. Items already enqueued stay readable;
+        consumers *blocked* in ``get()`` (and producers blocked in ``put()``)
+        are woken with :class:`ShutdownError` so no thread is stranded.
         """
         self._shutdown_event.set()
+        for q in self._queues:
+            q.close()
         if self._name is not None:
             with _REGISTRY_LOCK:
                 _REGISTRY.pop(self._name, None)

@@ -17,18 +17,31 @@ one-process-per-host topology; this module restores the reference's
   ``JaxShufflingDataset`` — same consumer code as in-process, matching
   the reference's connect-by-name contract (retry with doubling backoff).
 
-Wire format, little-endian: requests are ``(u32 queue_idx)``; responses
-are ``(u8 kind, u64 length, payload)`` with kind 0=table IPC stream,
-1=epoch-end sentinel, 2=shuffle-failure (payload = error text).
+Round-trip amortization (the reference's batched actor ops existed for
+exactly this, reference: multiqueue.py:127-154): a GET request carries
+``max_items``; the server answers with one *batch* — a blocking get for
+the first item, then an opportunistic non-blocking drain of up to
+``max_items - 1`` more, stopping at an epoch sentinel. The consumer
+buffers the batch locally and, while the trainer chews on it, a
+background prefetcher keeps one batched request in flight — so steady
+state pays ~one round trip per ``max_items`` tables and overlaps the
+wire time with consumption.
+
+Wire format, little-endian: requests are ``(u8 op=1, u32 queue_idx,
+u32 max_items)``; responses are ``(u32 count)`` followed by ``count``
+frames of ``(u8 kind, u64 length, payload)`` with kind 0=table IPC
+stream, 1=epoch-end sentinel, 2=shuffle-failure (payload = error text).
 """
 
 from __future__ import annotations
 
+import collections
+import concurrent.futures as cf
 import socket
 import struct
 import threading
 import time
-from typing import Optional, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import pyarrow as pa
 
@@ -38,12 +51,17 @@ from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
 
-_REQUEST = struct.Struct("<I")
-_RESPONSE = struct.Struct("<BQ")
+_REQUEST = struct.Struct("<BII")
+_BATCH_HEADER = struct.Struct("<I")
+_FRAME = struct.Struct("<BQ")
+
+OP_GET_BATCH = 1
 
 KIND_TABLE = 0
 KIND_SENTINEL = 1
 KIND_FAILURE = 2
+
+DEFAULT_MAX_BATCH = 8
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -65,10 +83,29 @@ def _serialize(table: pa.Table) -> pa.Buffer:
     return sink.getvalue()
 
 
+def _item_frame(item) -> Tuple[int, bytes]:
+    """Convert one queued item into a ``(kind, payload)`` frame."""
+    if item is None:
+        return KIND_SENTINEL, b""
+    if isinstance(item, ShuffleFailure):
+        return KIND_FAILURE, repr(item.error).encode()
+    try:
+        table = item.result() if hasattr(item, "result") else item
+        from ray_shuffling_data_loader_tpu import spill
+        table = spill.unwrap(table)
+        return KIND_TABLE, _serialize(table)
+    except Exception as e:  # noqa: BLE001 - forwarded
+        # A failed shuffle task ref: the consumer gets the real cause as
+        # a failure frame, not a dead socket.
+        return KIND_FAILURE, repr(e).encode()
+
+
 class QueueServer:
     """Exports a ``MultiQueue`` over TCP. One thread per consumer
-    connection; a GET blocks server-side until the queue yields (and the
-    ref materializes), so consumer backpressure is preserved."""
+    connection; the first item of each batched GET blocks server-side
+    until the queue yields (and the ref materializes), so consumer
+    backpressure is preserved; the rest of the batch is an opportunistic
+    non-blocking drain."""
 
     def __init__(self, queue: mq.MultiQueue, address: Tuple[str, int]):
         self._queue = queue
@@ -96,6 +133,20 @@ class QueueServer:
             threading.Thread(target=self._serve_conn, args=(conn,),
                              daemon=True, name="rsdl-qserve-conn").start()
 
+    def _drain_batch(self, queue_idx: int, max_items: int) -> List:
+        """One blocking get, then drain up to ``max_items - 1`` more
+        without blocking; stop after a sentinel/failure so requests never
+        cross an epoch boundary (a speculative get past the sentinel
+        would block forever on the drained per-epoch queue)."""
+        items = [self._queue.get(queue_idx, block=True)]
+        while (len(items) < max_items and items[-1] is not None
+               and not isinstance(items[-1], ShuffleFailure)):
+            try:
+                items.append(self._queue.get_nowait(queue_idx))
+            except mq.Empty:
+                break
+        return items
+
     def _serve_conn(self, conn: socket.socket) -> None:
         try:
             while not self._closed.is_set():
@@ -104,31 +155,28 @@ class QueueServer:
                     return  # consumer done
                 if len(raw) < _REQUEST.size:
                     raw += _recv_exact(conn, _REQUEST.size - len(raw))
-                (queue_idx,) = _REQUEST.unpack(raw)
-                item = self._queue.get(queue_idx, block=True)
-                if item is None:
-                    conn.sendall(_RESPONSE.pack(KIND_SENTINEL, 0))
-                elif isinstance(item, ShuffleFailure):
-                    text = repr(item.error).encode()
-                    conn.sendall(_RESPONSE.pack(KIND_FAILURE, len(text)))
-                    conn.sendall(text)
-                else:
-                    try:
-                        table = (item.result() if hasattr(item, "result")
-                                 else item)
-                        from ray_shuffling_data_loader_tpu import spill
-                        table = spill.unwrap(table)
-                        payload = _serialize(table)
-                    except Exception as e:  # noqa: BLE001 - forwarded
-                        # A failed shuffle task ref: the consumer gets the
-                        # real cause as a failure frame, not a dead socket.
-                        text = repr(e).encode()
-                        conn.sendall(
-                            _RESPONSE.pack(KIND_FAILURE, len(text)))
-                        conn.sendall(text)
-                        continue
-                    conn.sendall(_RESPONSE.pack(KIND_TABLE, payload.size))
-                    conn.sendall(payload)
+                op, queue_idx, max_items = _REQUEST.unpack(raw)
+                if op != OP_GET_BATCH:
+                    raise ConnectionError(f"unknown request op {op}")
+                try:
+                    items = self._drain_batch(queue_idx, max(1, max_items))
+                except mq.ShutdownError as e:
+                    # Queue shut down under a blocked GET: fail loudly
+                    # (the reference's actor kill surfaced as
+                    # RayActorError on the consumer).
+                    text = repr(e).encode()
+                    conn.sendall(_BATCH_HEADER.pack(1)
+                                 + _FRAME.pack(KIND_FAILURE, len(text))
+                                 + text)
+                    return
+                conn.sendall(_BATCH_HEADER.pack(len(items)))
+                for item in items:
+                    kind, payload = _item_frame(item)
+                    size = (payload.size if isinstance(payload, pa.Buffer)
+                            else len(payload))
+                    conn.sendall(_FRAME.pack(kind, size))
+                    if size:
+                        conn.sendall(payload)
         except (ConnectionError, OSError) as e:
             if not self._closed.is_set():
                 logger.warning("queue server connection dropped: %s", e)
@@ -167,11 +215,18 @@ class RemoteQueue:
     ``ShufflingDataset(batch_queue=RemoteQueue(addr), shuffle_result=None)``
     is a drop-in remote trainer. Connects with the reference's
     retry-with-doubling-backoff schedule (reference: multiqueue.py:310-332).
+
+    ``max_batch`` tables ride each round trip, and with ``prefetch=True``
+    (default) a background thread keeps the next batched request in
+    flight while the consumer drains the local buffer — the wire is
+    overlapped with consumption instead of serialized against it.
     """
 
     def __init__(self, address: Tuple[str, int],
                  retries: int = mq.CONNECT_RETRIES,
-                 initial_backoff_s: float = mq.CONNECT_INITIAL_BACKOFF_S):
+                 initial_backoff_s: float = mq.CONNECT_INITIAL_BACKOFF_S,
+                 max_batch: int = DEFAULT_MAX_BATCH,
+                 prefetch: bool = True):
         last_err: Optional[Exception] = None
         backoff = initial_backoff_s
         for attempt in range(retries + 1):
@@ -191,24 +246,84 @@ class RemoteQueue:
             raise ConnectionError(
                 f"could not reach queue server at {address} after "
                 f"{retries + 1} attempts: {last_err}")
-        self._lock = threading.Lock()
+        self._max_batch = max(1, max_batch)
+        self._prefetch = prefetch
+        self._io_lock = threading.Lock()      # serializes wire round trips
+        self._state_lock = threading.Lock()   # guards buffers/done/pending
+        self._buffers: Dict[int, collections.deque] = \
+            collections.defaultdict(collections.deque)
+        self._done: set = set()
+        self._pending: Dict[int, cf.Future] = {}
+        self._io = cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rsdl-rqueue-prefetch")
+
+    def _fetch_batch(self, queue_index: int) -> List:
+        """One wire round trip: request up to ``max_batch`` items and
+        decode the response frames. Runs on the caller's thread or the
+        prefetcher; ``_io_lock`` keeps round trips whole."""
+        with self._io_lock:
+            self._sock.sendall(
+                _REQUEST.pack(OP_GET_BATCH, queue_index, self._max_batch))
+            (count,) = _BATCH_HEADER.unpack(
+                _recv_exact(self._sock, _BATCH_HEADER.size))
+            frames = []
+            for _ in range(count):
+                kind, length = _FRAME.unpack(
+                    _recv_exact(self._sock, _FRAME.size))
+                payload = _recv_exact(self._sock, length) if length else b""
+                frames.append((kind, payload))
+        items: List = []
+        for kind, payload in frames:
+            if kind == KIND_SENTINEL:
+                items.append(None)
+                break  # epoch over; nothing valid can follow
+            if kind == KIND_FAILURE:
+                items.append(ShuffleFailure(RuntimeError(payload.decode())))
+                break
+            with pa.ipc.open_stream(pa.BufferReader(payload)) as reader:
+                items.append(reader.read_all())
+        return items
+
+    def _epoch_over(self, item) -> bool:
+        return item is None or isinstance(item, ShuffleFailure)
+
+    def _ingest(self, queue_index: int, items: List) -> None:
+        buf = self._buffers[queue_index]
+        buf.extend(items)
+        if items and self._epoch_over(items[-1]):
+            self._done.add(queue_index)
 
     def get(self, queue_index: int, block: bool = True):
         if not block:
             raise ValueError("RemoteQueue only supports blocking gets")
-        with self._lock:
-            self._sock.sendall(_REQUEST.pack(queue_index))
-            header = _recv_exact(self._sock, _RESPONSE.size)
-            kind, length = _RESPONSE.unpack(header)
-            payload = _recv_exact(self._sock, length) if length else b""
-        if kind == KIND_SENTINEL:
-            return None
-        if kind == KIND_FAILURE:
-            return ShuffleFailure(RuntimeError(payload.decode()))
-        with pa.ipc.open_stream(pa.BufferReader(payload)) as reader:
-            return reader.read_all()
+        with self._state_lock:
+            buf = self._buffers[queue_index]
+            while not buf:
+                if queue_index in self._done:
+                    raise RuntimeError(
+                        f"remote queue {queue_index} already yielded its "
+                        f"epoch-end sentinel")
+                fut = self._pending.pop(queue_index, None)
+                # Do the (possibly long) wire wait without holding the
+                # state lock, so a concurrent get on another queue index
+                # can still drain its local buffer.
+                self._state_lock.release()
+                try:
+                    items = (self._fetch_batch(queue_index)
+                             if fut is None else fut.result())
+                finally:
+                    self._state_lock.acquire()
+                self._ingest(queue_index, items)
+            item = buf.popleft()
+            if (self._prefetch and not buf
+                    and queue_index not in self._done
+                    and queue_index not in self._pending):
+                self._pending[queue_index] = self._io.submit(
+                    self._fetch_batch, queue_index)
+        return item
 
     def close(self) -> None:
+        self._io.shutdown(wait=False, cancel_futures=True)
         try:
             self._sock.close()
         except OSError:

@@ -429,6 +429,12 @@ def frame_send(fd: int, header, payload) -> None:
         raise OSError(-rc, os.strerror(-rc))
 
 
+# Mirrors RSDL_EEOF_MID_MESSAGE in shuffle_native.cpp: a sentinel far
+# outside the errno range, so real socket errnos (including a genuine
+# EPIPE from read()) are reported faithfully.
+_EEOF_MID_MESSAGE = 1000000
+
+
 def read_exact_into(fd: int, buf: np.ndarray, n: int) -> bool:
     """Read exactly ``n`` bytes from ``fd`` into ``buf`` with one GIL-free
     call. Returns True on success, False on clean EOF before the first
@@ -443,8 +449,8 @@ def read_exact_into(fd: int, buf: np.ndarray, n: int) -> bool:
     if got == 0:
         return False
     err = -got
-    if err == _errno.EPIPE:
-        raise OSError(err, "peer closed connection mid-message")
+    if err == _EEOF_MID_MESSAGE:
+        raise OSError(_errno.EPIPE, "peer closed connection mid-message")
     raise OSError(err, os.strerror(err))
 
 

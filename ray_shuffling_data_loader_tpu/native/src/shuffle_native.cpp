@@ -417,8 +417,15 @@ int rsdl_frame_send(int fd, const void* header, int64_t hlen,
   return 0;
 }
 
+// Sentinel for EOF after a partial read. Deliberately far outside the
+// errno range (errnos are small positive ints) so a genuine EPIPE errno
+// returned by read() stays distinguishable from a clean peer close
+// mid-frame.
+const int64_t RSDL_EEOF_MID_MESSAGE = 1000000;
+
 // Read exactly n bytes into dst. Returns n on success, 0 on clean EOF
-// before the first byte, -EPIPE on EOF mid-read, -errno on error.
+// before the first byte, -RSDL_EEOF_MID_MESSAGE on EOF mid-read,
+// -errno on error.
 int64_t rsdl_read_exact(int fd, void* dst, int64_t n) {
   int64_t got = 0;
   while (got < n) {
@@ -428,7 +435,7 @@ int64_t rsdl_read_exact(int fd, void* dst, int64_t n) {
       if (errno == EINTR) continue;
       return -errno;
     }
-    if (r == 0) return got == 0 ? 0 : -EPIPE;
+    if (r == 0) return got == 0 ? 0 : -RSDL_EEOF_MID_MESSAGE;
     got += r;
   }
   return got;

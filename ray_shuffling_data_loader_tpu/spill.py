@@ -20,6 +20,7 @@ import os
 import shutil
 import tempfile
 import threading
+import time
 import weakref
 from typing import Callable, Optional
 
@@ -163,6 +164,12 @@ def make_budget_state(file_cache, max_inflight_bytes: Optional[int],
     ledger_at_start = (_start_ledger.bytes_in_use()
                        + _start_ledger.freelist_bytes())
     cache_at_start = cache_bytes()
+    # The free-list trim releases warm buffers process-wide (other
+    # pipelines' included) and re-paying mmap + first-touch faults on every
+    # recv defeats recycling, so under SUSTAINED budget pressure trim at
+    # most once per cooldown window instead of on every over-budget probe.
+    _TRIM_COOLDOWN_S = 1.0
+    last_trim = [float("-inf")]
 
     def over_budget() -> bool:
         if max_inflight_bytes is None:
@@ -178,9 +185,17 @@ def make_budget_state(file_cache, max_inflight_bytes: Optional[int],
 
         if transient() <= max_inflight_bytes:
             return False
-        if ledger.freelist_bytes():
+        now = time.monotonic()
+        if (ledger.freelist_bytes()
+                and now - last_trim[0] >= _TRIM_COOLDOWN_S):
+            last_trim[0] = now
             ledger.trim_freelist()
-        return transient() > max_inflight_bytes
+            return transient() > max_inflight_bytes
+        # Inside the cooldown the freelist is still reclaimable — don't
+        # declare over-budget (and spill/stall) on bytes a trim would
+        # release; judge only the non-reclaimable share.
+        return (transient() - ledger.freelist_bytes()
+                > max_inflight_bytes)
 
     manager = None
     if spill_dir is not None and max_inflight_bytes is not None:

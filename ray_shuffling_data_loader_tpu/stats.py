@@ -27,6 +27,7 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ray_shuffling_data_loader_tpu.utils import fileio
 from ray_shuffling_data_loader_tpu.utils.humanize import (
     human_readable_big_num, human_readable_size)
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
@@ -465,7 +466,7 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
                   max_concurrent_epochs: int) -> None:
     """Write trial + epoch CSVs and print the summary
     (reference: stats.py:255-574; same signature, same columns)."""
-    os.makedirs(stats_dir, exist_ok=True)
+    fileio.makedirs(stats_dir)
     stats_list = [s for s, _ in all_stats]
     store_stats_list = [ss for _, ss in all_stats]
     times = [s.duration for s in stats_list]
@@ -495,9 +496,8 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
     def _open_report(kind: str):
         filename = f"{kind}_stats_{hr_rows}_rows_{hr_batch}_batch_size"
         filename += f"_{now}.csv" if unique_stats else ".csv"
-        path = os.path.join(stats_dir, filename)
-        header = (overwrite_stats or not os.path.exists(path)
-                  or os.path.getsize(path) == 0)
+        path = fileio.join(stats_dir, filename)
+        header = (overwrite_stats or fileio.file_size(path) == 0)
         return path, header
 
     static = {
@@ -511,7 +511,7 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
 
     path, header = _open_report("trial")
     logger.info("Writing trial stats to %s", path)
-    with open(path, write_mode) as f:
+    with fileio.open_text(path, write_mode) as f:
         writer = csv.DictWriter(f, fieldnames=TRIAL_FIELDNAMES)
         if header:
             writer.writeheader()
@@ -566,7 +566,7 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
         return
     path, header = _open_report("epoch")
     logger.info("Writing epoch stats to %s", path)
-    with open(path, write_mode) as f:
+    with fileio.open_text(path, write_mode) as f:
         writer = csv.DictWriter(f, fieldnames=EPOCH_FIELDNAMES)
         if header:
             writer.writeheader()

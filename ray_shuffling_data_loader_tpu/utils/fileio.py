@@ -94,3 +94,62 @@ def listdir(path: str) -> List[str]:
     # leading '/', native ones (gs/s3) as 'bucket/key' — normalize both.
     return sorted(f"{scheme}://{info.path.lstrip('/')}" for info in infos
                   if info.type == pafs.FileType.File)
+
+
+def file_size(path: str) -> int:
+    """Size in bytes of ``path``, 0 if it does not exist."""
+    fs, inner = parse_uri(path)
+    if fs is None:
+        return os.path.getsize(inner) if os.path.exists(inner) else 0
+    import pyarrow.fs as pafs
+    info = fs.get_file_info(inner)
+    return info.size if info.type == pafs.FileType.File else 0
+
+
+class _RemoteTextFile:
+    """Buffered text writer for remote URIs.
+
+    Object stores have no append, so ``mode='a'`` reads any existing
+    object first and re-uploads the concatenation on close — fine for
+    the CSV reports this backs (the reference appended to s3 CSVs via
+    smart_open the same rewrite-on-close way,
+    reference: stats.py:283-287)."""
+
+    def __init__(self, fs, inner: str, mode: str):
+        import io
+        self._fs = fs
+        self._inner = inner
+        self._buf = io.StringIO()
+        self._closed = False
+        if "a" in mode:
+            import pyarrow.fs as pafs
+            if fs.get_file_info(inner).type == pafs.FileType.File:
+                with fs.open_input_stream(inner) as f:
+                    self._buf.write(f.read().decode())
+
+    def write(self, text: str) -> int:
+        return self._buf.write(text)
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        with self._fs.open_output_stream(self._inner) as f:
+            f.write(self._buf.getvalue().encode())
+
+    def __enter__(self) -> "_RemoteTextFile":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def open_text(path: str, mode: str = "w"):
+    """Open a text file for writing on any filesystem.
+
+    ``mode`` is ``'w'``/``'a'`` (a trailing ``'+'`` is tolerated and
+    ignored — the CSV writers never read back through the handle)."""
+    fs, inner = parse_uri(path)
+    if fs is None:
+        return open(inner, mode.replace("+", ""), newline="")
+    return _RemoteTextFile(fs, inner, mode)

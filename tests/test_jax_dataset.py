@@ -581,3 +581,34 @@ def test_device_rebatch_empty_reducer_tables(tmp_path):
     ds.set_epoch(0)
     rows = sum(int(lb.shape[0]) for _, lb in ds)
     assert rows == 6
+
+
+def test_device_rebatch_auto_falls_back_on_repacking_spec(tmp_path):
+    """When device_rebatch was resolved from "auto" (not explicitly
+    requested), a spec that repacks the sample dimension must NOT break the
+    job mid-epoch: the producer falls back to per-batch transfers and the
+    batch stream matches the host path exactly (ADVICE r3, medium)."""
+    filenames = write_files(tmp_path, num_files=1, rows_per_file=128)
+
+    def run(device_rebatch, qname, mark_auto=False):
+        ds = jd.JaxShufflingDataset(
+            filenames, num_epochs=1, num_trainers=1, batch_size=16, rank=0,
+            feature_columns=["emb_1"], feature_shapes=[(2,)],
+            feature_types=[np.int32],
+            label_column="labels", num_reducers=2, seed=0,
+            queue_name=qname, device_rebatch=device_rebatch)
+        if mark_auto:
+            # Simulate "auto" resolution (the CPU test backend resolves
+            # auto to False, so flag the converter directly).
+            ds._converter.device_rebatch_auto = True
+        ds.set_epoch(0)
+        return [(tuple(np.asarray(f) for f in feats), np.asarray(lb))
+                for feats, lb in ds]
+
+    host = run(False, "jax-repack-fb-host")
+    fallback = run(True, "jax-repack-fb-auto", mark_auto=True)
+    assert len(host) == len(fallback) == 8  # 128 rows / 16-row batches
+    for (fa, la), (fb, lb) in zip(host, fallback):
+        for x, y in zip(fa, fb):
+            np.testing.assert_array_equal(x, y)
+        np.testing.assert_array_equal(la, lb)

@@ -236,3 +236,56 @@ def test_bounded_fifo_blocking_handoff():
     f.put("b", timeout=5)  # unblocks once consumer takes "a"
     t.join(timeout=5)
     assert got == ["a", "b"]
+
+
+def test_shutdown_wakes_blocked_getter():
+    """A consumer blocked in get() exits promptly with ShutdownError when the
+    queue shuts down (reference: multiqueue.py:285-307 — actor kill made
+    blocked consumers fail loudly)."""
+    q = make_queue()
+    errors = []
+
+    def consumer():
+        try:
+            q.get(0, block=True)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=consumer)
+    t.start()
+    time.sleep(0.1)  # let the consumer block
+    start = time.monotonic()
+    q.shutdown()
+    t.join(timeout=5)
+    assert not t.is_alive(), "blocked getter was stranded by shutdown"
+    assert time.monotonic() - start < 2.0
+    assert len(errors) == 1 and isinstance(errors[0], mq.ShutdownError)
+
+
+def test_shutdown_wakes_blocked_putter():
+    q = mq.MultiQueue(num_queues=1, maxsize=1)
+    q.put(0, "fill")
+    errors = []
+
+    def producer():
+        try:
+            q.put(0, "blocked", block=True)
+        except Exception as e:  # noqa: BLE001
+            errors.append(e)
+
+    t = threading.Thread(target=producer)
+    t.start()
+    time.sleep(0.1)
+    q.shutdown()
+    t.join(timeout=5)
+    assert not t.is_alive(), "blocked putter was stranded by shutdown"
+    assert len(errors) == 1 and isinstance(errors[0], mq.ShutdownError)
+
+
+def test_shutdown_keeps_enqueued_items_readable():
+    q = make_queue()
+    q.put(0, "kept")
+    q.shutdown()
+    assert q.get(0) == "kept"
+    with pytest.raises(mq.ShutdownError):
+        q.get(0, block=True)
