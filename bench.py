@@ -1,31 +1,48 @@
 """Headline benchmark: rows/sec/chip ingested through the full pipeline.
 
-Measures the BASELINE.md primary metric: rows per second streamed from
-shuffled Parquet through the map/reduce shuffle, re-batching, Arrow->NumPy
-conversion, and ``jax.device_put`` onto the accelerator (a tiny jitted
-reduction per batch forces materialization on device, so transfers are not
-imaginary). This is the loader path a real trainer consumes
-(reference harness analog: benchmarks/benchmark.py + the batch-wait metric
-of examples/horovod/ray_torch_shuffle.py:186-218).
+ONE invocation runs three timed phases and prints ONE JSON line
+(``{"metric", "value", "unit", "vs_baseline", ...}``):
 
-``vs_baseline`` compares against the reference's algorithm run the way the
-reference runs it per core — pandas ``read_parquet``, boolean-mask
-partitioning, ``pd.concat`` + ``sample(frac=1)``, sequential single process
-(reference: shuffle.py:199-247) — measured on the same data and host in the
-same run.
+1. **cached** — rows/s streamed from shuffled Parquet through the
+   map/reduce shuffle, re-batching, Arrow->NumPy conversion, and
+   ``jax.device_put`` onto the accelerator, with the cross-epoch
+   file-table cache on (decode paid once). A tiny jitted reduction per
+   batch forces materialization on device. This is the headline
+   ``value``.
+2. **cold** — same pipeline with the file cache off, so every epoch
+   re-reads + re-decodes Parquet: the reference's 64 GB operating regime
+   (reference: benchmarks/benchmark_batch.sh:9-18). ``vs_baseline`` is
+   THIS number over the pandas reference algorithm — both sides pay full
+   decode, the honest apples-to-apples (``vs_baseline_cached`` records
+   the cached ratio).
+3. **train** — the BASELINE.md contract metric: a REAL DLRM train step
+   (models/dlrm.py, Adam updates — not a mock sleep) consumes the
+   stream, and the phase reports ``stall_pct_under_train`` (share of
+   wall-clock the trainer spent waiting on the input pipeline,
+   reference's own metric: examples/horovod/ray_torch_shuffle.py:186-218)
+   plus train-gated rows/s. Contract: <= 10% stall.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The pandas baseline runs the reference's algorithm the way the reference
+runs it per core — pandas ``read_parquet``, boolean-mask partitioning,
+``pd.concat`` + ``sample(frac=1)``, sequential single process
+(reference: shuffle.py:199-247) — on the same data and host in the same
+run.
 
 Env knobs: RSDL_BENCH_ROWS, RSDL_BENCH_FILES, RSDL_BENCH_EPOCHS,
 RSDL_BENCH_BATCH, RSDL_BENCH_PREFETCH (batches in flight, default 4),
 RSDL_BENCH_CPU=1 (force CPU backend for smoke runs),
-RSDL_BENCH_COLD=1 (disable the file-table cache so every epoch re-reads +
-re-decodes Parquet — the reference's 64 GB operating regime, where the
-corpus does not fit memory), RSDL_BENCH_DATA (data cache dir),
-RSDL_BENCH_DEVICE_REBATCH=0/1 (force the per-batch host path / the bulk
-device-rebatch path; default auto), RSDL_BENCH_STEP_MS (emulated per-batch
-train-step time for the stall%-under-load regime), RSDL_BENCH_REDUCERS
-(override the reducer count).
+RSDL_BENCH_PHASES (csv subset of "cached,cold,train", default all),
+RSDL_BENCH_COLD=1 (legacy: make the cold phase the headline and skip
+cached), RSDL_BENCH_COLD_EPOCHS (default 4), RSDL_BENCH_TRAIN_EPOCHS
+(default 4), RSDL_BENCH_TRAIN_BATCH (default 131072),
+RSDL_BENCH_TRAIN_MODEL=tiny|base|mlperf (DLRM scale for the train phase;
+default mlperf — MLPerf-DLRM-v2-like widths; tiny on CPU),
+RSDL_BENCH_TRAIN_MICROBATCH (rows per real train step; the loader chunk
+is consumed as batch/microbatch on-device-sliced steps, default 2048),
+RSDL_BENCH_DATA (data cache dir), RSDL_BENCH_DEVICE_REBATCH=0/1 (force
+the per-batch host path / the bulk device-rebatch path; default auto),
+RSDL_BENCH_STEP_MS (emulated per-batch step time in the ingest phases),
+RSDL_BENCH_REDUCERS (override the reducer count).
 """
 
 from __future__ import annotations
@@ -67,6 +84,182 @@ def _pandas_reference_baseline(filenames, num_reducers: int,
     return total_rows / duration
 
 
+def _make_dataset(filenames, *, num_epochs, batch_size, num_reducers,
+                  prefetch_size, cold, device_rebatch, qname):
+    from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
+    from ray_shuffling_data_loader_tpu.workloads.dlrm_criteo import dlrm_spec
+    return JaxShufflingDataset(
+        filenames, num_epochs=num_epochs, num_trainers=1,
+        batch_size=batch_size, rank=0,
+        num_reducers=num_reducers, max_concurrent_epochs=2, seed=0,
+        queue_name=qname, drop_last=True,
+        prefetch_size=prefetch_size,
+        file_cache=None if cold else "auto",
+        device_rebatch=device_rebatch, **dlrm_spec())
+
+
+def run_ingest(jax, filenames, *, num_epochs, batch_size, num_reducers,
+               prefetch_size, cold, device_rebatch, step_ms, qname) -> dict:
+    """Timed ingest: shuffle -> batches -> device, near-zero consumer.
+
+    Epoch 0 is warm-up (compile + cache fill) and excluded from the timed
+    window unless there is only one epoch.
+    """
+    import jax.numpy as jnp
+
+    ds = _make_dataset(filenames, num_epochs=num_epochs,
+                       batch_size=batch_size, num_reducers=num_reducers,
+                       prefetch_size=prefetch_size, cold=cold,
+                       device_rebatch=device_rebatch, qname=qname)
+    # Tiny jitted reduction per batch: forces the batch to land on device;
+    # negligible compute (sparse-feature columns arrive as one pytree
+    # transfer and are consumed per-column, the DLRM access pattern).
+    touch = jax.jit(
+        lambda fs, y: sum(f.sum(dtype=jnp.int32) for f in fs)
+        + y.sum(dtype=jnp.float32))
+
+    rows_consumed = 0
+    start = timeit.default_timer()
+    last = None
+    for epoch in range(num_epochs):
+        ds.set_epoch(epoch)
+        for features, label in ds:
+            last = touch(features, label)
+            if step_ms:
+                time.sleep(step_ms / 1e3)
+            if epoch > 0 or num_epochs == 1:
+                rows_consumed += label.shape[0]
+        if epoch == 0 and num_epochs > 1:
+            jax.block_until_ready(last)
+            # Exclude warm-up/compile waits from the stall metric: the
+            # contract number is about steady state, not first-compile.
+            ds.batch_wait_stats.reset()
+            start = timeit.default_timer()
+    jax.block_until_ready(last)
+    duration = max(timeit.default_timer() - start, 1e-9)
+    ds.close()
+    wait = ds.batch_wait_stats.summary()
+    return {
+        "rows_per_s": rows_consumed / duration,
+        "stall_s": wait["total"],
+        "stall_pct": 100.0 * wait["total"] / duration,
+        "wait_mean_ms": wait["mean"] * 1e3,
+        "batches": wait["count"],
+        "timed_epochs": num_epochs - 1 if num_epochs > 1 else 1,
+        "duration_s": duration,
+    }
+
+
+def run_train(jax, filenames, *, num_epochs, batch_size, num_reducers,
+              prefetch_size, device_rebatch, model_size, microbatch,
+              qname) -> dict:
+    """The contract phase: real jitted DLRM train steps consume the
+    stream; reports stall% (batch-wait share of wall-clock) and
+    train-gated rows/s. Epoch 0 (compile) is excluded.
+
+    The trainer is MICRO-BATCHED, the standard large-batch recommender
+    setup: the loader delivers ``batch_size``-row device chunks (bulk
+    transfers at the granularity the wire likes), and the trainer runs
+    one real train step (fwd+bwd+Adam update, models/dlrm.py — not a
+    mock sleep) per ``microbatch``-row slice, carved on-device inside
+    the jitted step. Rows/s is gated by real training work; stall% is
+    the fraction of wall-clock the trainer spent blocked on the input
+    pipeline — the reference's own metric, measured around its
+    synchronous per-step loop (reference:
+    ray_torch_shuffle.py:186-219)."""
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    from jax import lax
+
+    from ray_shuffling_data_loader_tpu.models import dlrm
+
+    if model_size == "tiny":
+        # CPU smoke path: full-cardinality embedding grads are dense
+        # host-side and would swamp a tiny run.
+        cfg = dlrm.DLRMConfig(
+            vocab_sizes=tuple(min(v, 1000)
+                              for v in dlrm.DATA_SPEC_VOCAB_SIZES),
+            embed_dim=8, top_hidden=(64, 32),
+            compute_dtype=jnp.float32)
+    elif model_size == "base":
+        cfg = dlrm.DLRMConfig()  # embed 32, top (512, 256)
+    else:
+        # Production-representative scale (MLPerf DLRM-v2-like MLP widths
+        # on the reference's own 17-table schema): this is what a real
+        # recommender train step costs per row, and the scale BASELINE's
+        # >=90%-utilization contract is about.
+        cfg = dlrm.DLRMConfig(embed_dim=128,
+                              top_hidden=(1024, 1024, 512, 256))
+    params = dlrm.init(cfg, jax.random.key(0))
+    opt = optax.adam(1e-3)
+    opt_state = opt.init(params)
+
+    mb = min(microbatch, batch_size)
+    if batch_size % mb:
+        # Round DOWN to the largest divisor so the step granularity (and
+        # hence the contract stall metric) stays close to what was asked
+        # for, and say so — silently training one giant step per chunk
+        # would change the number being measured.
+        mb = next(d for d in range(mb, 0, -1) if batch_size % d == 0)
+        print(f"# train microbatch {microbatch} does not divide chunk "
+              f"{batch_size}; using {mb}", file=sys.stderr)
+    steps_per_chunk = batch_size // mb
+
+    @jax.jit
+    def micro_step(params, opt_state, cols, labels, i):
+        mcols = [lax.dynamic_slice_in_dim(c, i * mb, mb, axis=0)
+                 for c in cols]
+        mlab = lax.dynamic_slice_in_dim(labels, i * mb, mb, axis=0)
+        loss, grads = jax.value_and_grad(
+            lambda p: dlrm.loss_fn(cfg, p, None, mcols, mlab))(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    ds = _make_dataset(filenames, num_epochs=num_epochs,
+                       batch_size=batch_size, num_reducers=num_reducers,
+                       prefetch_size=prefetch_size, cold=False,
+                       device_rebatch=device_rebatch, qname=qname)
+    rows_consumed = 0
+    steps = 0
+    loss = None
+    start = timeit.default_timer()
+    for epoch in range(num_epochs):
+        ds.set_epoch(epoch)
+        for features, label in ds:
+            for i in range(steps_per_chunk):
+                params, opt_state, loss = micro_step(
+                    params, opt_state, features, label, np.int32(i))
+                if epoch > 0 or num_epochs == 1:
+                    rows_consumed += mb
+                    steps += 1
+        if epoch == 0 and num_epochs > 1:
+            jax.block_until_ready(loss)
+            ds.batch_wait_stats.reset()
+            start = timeit.default_timer()
+    jax.block_until_ready(loss)
+    duration = max(timeit.default_timer() - start, 1e-9)
+    ds.close()
+    wait = ds.batch_wait_stats.summary()
+    stall_s = wait["total"]
+    return {
+        "rows_per_s": rows_consumed / duration,
+        "stall_s": stall_s,
+        "stall_pct": 100.0 * stall_s / duration,
+        "wait_mean_ms": wait["mean"] * 1e3,
+        # Mean train-step time the pipeline had to beat: everything that
+        # wasn't batch-wait, per micro-step.
+        "step_ms_mean": ((duration - stall_s) / max(1, steps)) * 1e3,
+        "batches": steps,
+        "batch_size": batch_size,
+        "microbatch": mb,
+        "final_loss": float(loss) if loss is not None else None,
+        "timed_epochs": num_epochs - 1 if num_epochs > 1 else 1,
+        "duration_s": duration,
+        "model_size": model_size,
+    }
+
+
 def main() -> None:
     if os.environ.get("RSDL_BENCH_CPU"):
         os.environ.setdefault(
@@ -76,11 +269,7 @@ def main() -> None:
     else:
         import jax
 
-    import jax.numpy as jnp
-    import numpy as np
-
     from ray_shuffling_data_loader_tpu import data_generation as datagen
-    from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
     from ray_shuffling_data_loader_tpu.utils.config import default_num_reducers
 
     num_rows = int(os.environ.get("RSDL_BENCH_ROWS", 2_000_000))
@@ -100,7 +289,6 @@ def main() -> None:
 
     marker = os.path.join(data_dir, f".rows_{num_rows}_files_{num_files}")
     if not os.path.exists(marker):
-        import glob
         import shutil
         if os.path.isdir(data_dir):
             shutil.rmtree(data_dir)
@@ -151,80 +339,78 @@ def main() -> None:
         "RSDL_BENCH_REDUCERS",
         min(max(4, default_num_reducers(num_trainers=1)), reducer_cap)))
 
-    # Narrowest dtype per column that covers its cardinality, cast at the
-    # map stage: every downstream byte — partition, permute-gather,
-    # re-batch, host->HBM DMA — is 43B/row instead of 76B. Indices widen
-    # for free on device (workloads/dlrm_criteo.py).
-    from ray_shuffling_data_loader_tpu.workloads.dlrm_criteo import dlrm_spec
-
     # Deeper prefetch keeps more host->device transfers in flight — on a
     # tunneled/high-latency device link this hides most of the copy time.
     prefetch_size = int(os.environ.get("RSDL_BENCH_PREFETCH", 4))
-
-    # Cold mode: no file-table cache, so the timed epochs pay Parquet read
-    # + decode every epoch (the regime of the reference's 64 GB runs,
-    # reference: benchmarks/benchmark_batch.sh:9-18). Default (cached) mode
-    # measures the steady state where the working set fits host memory.
-    cold = bool(os.environ.get("RSDL_BENCH_COLD"))
 
     # RSDL_BENCH_DEVICE_REBATCH=0 forces the per-batch host path for
     # apples-to-apples comparisons of the bulk-chunk transfer design.
     rebatch_env = os.environ.get("RSDL_BENCH_DEVICE_REBATCH", "").strip()
     device_rebatch = "auto" if rebatch_env == "" \
         else rebatch_env not in ("0", "false", "False")
-    ds = JaxShufflingDataset(
-        filenames, num_epochs=num_epochs, num_trainers=1,
-        batch_size=batch_size, rank=0,
-        num_reducers=num_reducers, max_concurrent_epochs=2, seed=0,
-        queue_name="bench-queue", drop_last=True,
-        prefetch_size=prefetch_size,
-        file_cache=None if cold else "auto",
-        device_rebatch=device_rebatch, **dlrm_spec())
 
-    # Tiny jitted reduction per batch: forces the batch to land on device;
-    # negligible compute (sparse-feature columns arrive as one pytree
-    # transfer and are consumed per-column, the DLRM access pattern).
-    touch = jax.jit(
-        lambda fs, y: sum(f.sum(dtype=jnp.int32) for f in fs)
-        + y.sum(dtype=jnp.float32))
-
-    # Warm-up epoch 0 separately to exclude one-time compile cost (with a
-    # single epoch there is no warm-up and compile time is included).
-    # RSDL_PROFILE_DIR=/tmp/tr captures a JAX profiler trace of the run.
-    from ray_shuffling_data_loader_tpu.utils.tracing import maybe_profile
-    # Optional per-batch train-step emulation: BASELINE's >=90%-utilization
-    # contract is about a TRAINER's stall fraction, and with a near-zero
-    # consumer the pipeline is producer-bound by construction (stall% ~=
-    # 100% minus nothing). RSDL_BENCH_STEP_MS sleeps per batch to measure
-    # stall% at a realistic step time; rows/s is then gated by the step.
+    # Optional per-batch train-step emulation in the ingest phases (the
+    # train phase uses the real model instead).
     step_ms = float(os.environ.get("RSDL_BENCH_STEP_MS", 0))
 
-    rows_consumed = 0
-    start = timeit.default_timer()
-    last = None
+    phases = [p.strip() for p in os.environ.get(
+        "RSDL_BENCH_PHASES", "cached,cold,train").split(",") if p.strip()]
+    if os.environ.get("RSDL_BENCH_COLD"):
+        # Legacy knob: the cold regime IS the headline; skip cached.
+        phases = [p for p in phases if p != "cached"]
+        if "cold" not in phases:
+            phases.insert(0, "cold")
+
+    from ray_shuffling_data_loader_tpu.utils.tracing import maybe_profile
+
+    cached = cold = train = None
     with maybe_profile():
-        for epoch in range(num_epochs):
-            ds.set_epoch(epoch)
-            for features, label in ds:
-                last = touch(features, label)
-                if step_ms:
-                    time.sleep(step_ms / 1e3)
-                if epoch > 0 or num_epochs == 1:
-                    rows_consumed += label.shape[0]
-            if epoch == 0 and num_epochs > 1:
-                jax.block_until_ready(last)
-                # Exclude warm-up/compile waits from the stall metric: the
-                # contract number (BASELINE.md: >=90% input-pipeline
-                # utilization) is about steady state, not first-compile.
-                ds.batch_wait_stats.reset()
-                start = timeit.default_timer()
-        jax.block_until_ready(last)
-    duration = max(timeit.default_timer() - start, 1e-9)
-    ds.close()
-    pipeline_rows_per_s = rows_consumed / duration
-    wait = ds.batch_wait_stats.summary()
-    stall_s = wait["total"]
-    stall_pct = 100.0 * stall_s / duration
+        if "cached" in phases:
+            cached = run_ingest(
+                jax, filenames, num_epochs=num_epochs,
+                batch_size=batch_size, num_reducers=num_reducers,
+                prefetch_size=prefetch_size, cold=False,
+                device_rebatch=device_rebatch, step_ms=step_ms,
+                qname="bench-cached")
+            print(f"# cached: {cached['rows_per_s']:,.0f} rows/s, stall "
+                  f"{cached['stall_pct']:.2f}% over {cached['batches']} "
+                  "batches", file=sys.stderr)
+        if "cold" in phases:
+            cold_epochs = int(os.environ.get("RSDL_BENCH_COLD_EPOCHS",
+                                             min(4, num_epochs)))
+            cold = run_ingest(
+                jax, filenames, num_epochs=cold_epochs,
+                batch_size=batch_size, num_reducers=num_reducers,
+                prefetch_size=prefetch_size, cold=True,
+                device_rebatch=device_rebatch, step_ms=step_ms,
+                qname="bench-cold")
+            print(f"# cold: {cold['rows_per_s']:,.0f} rows/s, stall "
+                  f"{cold['stall_pct']:.2f}% over {cold['batches']} "
+                  "batches", file=sys.stderr)
+        if "train" in phases:
+            train_epochs = int(os.environ.get("RSDL_BENCH_TRAIN_EPOCHS", 4))
+            train_batch = int(os.environ.get("RSDL_BENCH_TRAIN_BATCH",
+                                             131_072))
+            model_size = os.environ.get(
+                "RSDL_BENCH_TRAIN_MODEL",
+                "tiny" if os.environ.get("RSDL_BENCH_CPU") else "mlperf")
+            train_mb = int(os.environ.get("RSDL_BENCH_TRAIN_MICROBATCH",
+                                          2048))
+            train = run_train(
+                jax, filenames, num_epochs=train_epochs,
+                batch_size=train_batch,
+                num_reducers=num_reducers,
+                prefetch_size=prefetch_size,
+                device_rebatch=device_rebatch,
+                model_size=model_size, microbatch=train_mb,
+                qname="bench-train")
+            print(f"# train: {train['rows_per_s']:,.0f} rows/s over "
+                  f"{train['batches']} real DLRM micro-steps "
+                  f"({train['microbatch']} rows, "
+                  f"{train['step_ms_mean']:.2f}ms each), stall "
+                  f"{train['stall_pct']:.2f}% "
+                  f"(contract: <=10%), loss={train['final_loss']:.4f}",
+                  file=sys.stderr)
 
     # Best of two runs: the first warms the page cache, and taking the max
     # is fairest to the reference on a noisy shared host.
@@ -234,29 +420,43 @@ def main() -> None:
                                    num_reducers=max(2, num_reducers // 4),
                                    batch_size=batch_size)
         for _ in range(2))
-    print(f"# pipeline: {pipeline_rows_per_s:,.0f} rows/s | "
-          f"pandas reference algo: {baseline_rows_per_s:,.0f} rows/s | "
-          f"stall {stall_s:.3f}s ({stall_pct:.2f}%) over "
-          f"{wait['count']} batches | mode: "
-          f"{'cold (decode every epoch)' if cold else 'cached'}",
+    print(f"# pandas reference algo: {baseline_rows_per_s:,.0f} rows/s",
           file=sys.stderr)
 
-    print(json.dumps({
-        "metric": ("shuffle_ingest_rows_per_sec_per_chip_cold" if cold
-                   else "shuffle_ingest_rows_per_sec_per_chip"),
-        "value": round(pipeline_rows_per_s, 1),
+    if cached is not None:
+        headline, metric = cached, "shuffle_ingest_rows_per_sec_per_chip"
+    elif cold is not None:
+        headline = cold
+        metric = "shuffle_ingest_rows_per_sec_per_chip_cold"
+    elif train is not None:
+        # Train-only run: the headline is the train-gated rate (the train
+        # phase runs with the cache ON, so the cold metric name would lie).
+        headline, metric = train, "train_gated_rows_per_sec_per_chip"
+    else:
+        print(f"RSDL_BENCH_PHASES={phases!r} selected no phase",
+              file=sys.stderr)
+        sys.exit(2)
+    headline_cold = headline is cold
+    # vs_baseline is the HONEST ratio: the cold pipeline (decode every
+    # epoch) against the pandas reference algorithm, which also pays full
+    # decode. The cached ratio is reported separately.
+    if cold is not None:
+        vs_baseline = cold["rows_per_s"] / baseline_rows_per_s
+    else:
+        vs_baseline = headline["rows_per_s"] / baseline_rows_per_s
+
+    record = {
+        "metric": metric,
+        "value": round(headline["rows_per_s"], 1),
         "unit": "rows/s",
-        "vs_baseline": round(pipeline_rows_per_s / baseline_rows_per_s, 3),
-        # Contract metric (BASELINE.md): consumer time spent waiting on the
-        # input pipeline, warm-up excluded. With step_ms=0 (default) the
-        # consumer does ~no work, so stall% ~= 100% is expected and rows/s
-        # is the signal; set RSDL_BENCH_STEP_MS to a realistic train-step
-        # time to measure the >=90%-utilization regime (<=10% stall).
-        "stall_pct": round(stall_pct, 3),
-        "stall_s": round(stall_s, 3),
-        "batch_wait_mean_ms": round(wait["mean"] * 1e3, 3),
+        "vs_baseline": round(vs_baseline, 3),
+        # Headline-phase stall stats (near-zero consumer: stall% ~= 100%
+        # is expected there; the contract number is the train phase's).
+        "stall_pct": round(headline["stall_pct"], 3),
+        "stall_s": round(headline["stall_s"], 3),
+        "batch_wait_mean_ms": round(headline["wait_mean_ms"], 3),
         "step_ms": step_ms,
-        "cache_mode": "cold" if cold else "cached",
+        "cache_mode": "cold" if headline_cold else "cached",
         # Fairness note: the pandas baseline is a rate over a quarter of
         # the files (it is single-process and O(minutes) on the full set).
         "baseline_files_fraction": round(len(baseline_files) /
@@ -265,8 +465,36 @@ def main() -> None:
         # with cores; cross-round comparisons need this. (Round-1's 17.2M
         # was a many-core host; a 1-core host sustains ~4M.)
         "host_cpus": os.cpu_count(),
-        "timed_epochs": num_epochs - 1 if num_epochs > 1 else 1,
-    }))
+        "timed_epochs": headline["timed_epochs"],
+    }
+    if cached is not None:
+        record["vs_baseline_cached"] = round(
+            cached["rows_per_s"] / baseline_rows_per_s, 3)
+    if cold is not None and not headline_cold:
+        record.update({
+            "cold_rows_per_sec": round(cold["rows_per_s"], 1),
+            "cold_stall_pct": round(cold["stall_pct"], 3),
+            "cold_timed_epochs": cold["timed_epochs"],
+        })
+    if train is not None:
+        record.update({
+            # The BASELINE.md contract metric: <= 10% stall under a real
+            # train step (>= 90% input-pipeline utilization).
+            "stall_pct_under_train": round(train["stall_pct"], 3),
+            "train_rows_per_sec": round(train["rows_per_s"], 1),
+            "train_step_ms_mean": round(train["step_ms_mean"], 3),
+            "train_batch_size": train["batch_size"],
+            "train_microbatch": train["microbatch"],
+            "train_steps": train["batches"],
+            "train_stall_s": round(train["stall_s"], 3),
+            "train_wait_mean_ms": round(train["wait_mean_ms"], 3),
+            "train_final_loss": (round(train["final_loss"], 5)
+                                 if train["final_loss"] is not None
+                                 else None),
+            "train_model": f"dlrm-{train['model_size']}",
+        })
+
+    print(json.dumps(record))
 
 
 if __name__ == "__main__":

@@ -71,6 +71,10 @@ def parse_args(argv=None):
                         "train step compiles quickly on small hosts")
     p.add_argument("--distributed", action="store_true",
                    help="Initialize jax.distributed (one process per host)")
+    p.add_argument("--stats-dir", type=str, default=None,
+                   help="Write a per-rank CSV of epoch stats (steps, "
+                        "rows/s, loss, batch waits) here; local path or "
+                        "any utils/fileio URI (gs://, s3://, memory://)")
     return p.parse_args(argv)
 
 
@@ -84,7 +88,16 @@ def main(argv=None):
     else:
         import jax
     if args.distributed:
-        jax.distributed.initialize()
+        # On TPU pods initialize() self-configures from the metadata
+        # service; elsewhere (the slice launcher's SSH/local fan-out) the
+        # coordinates come from env vars it sets per host.
+        if os.environ.get("JAX_NUM_PROCESSES"):
+            jax.distributed.initialize(
+                coordinator_address=os.environ["JAX_COORDINATOR_ADDRESS"],
+                num_processes=int(os.environ["JAX_NUM_PROCESSES"]),
+                process_id=int(os.environ["JAX_PROCESS_ID"]))
+        else:
+            jax.distributed.initialize()
 
     import numpy as np
     import optax
@@ -201,6 +214,8 @@ def main(argv=None):
                 NamedSharding(mesh, P("data", *([None] * (arr.ndim - 1)))),
                 np.asarray(arr))
 
+    epoch_rows = []
+    run_wait_total, run_wait_count = 0.0, 0
     for epoch in range(args.num_epochs):
         ds.set_epoch(epoch)
         epoch_start = timeit.default_timer()
@@ -231,16 +246,47 @@ def main(argv=None):
             trainer.block_until_ready()
             last_loss = float(last_loss)
         duration = timeit.default_timer() - epoch_start
+        # Per-EPOCH waits: reset after each epoch so rows/prints aren't
+        # cumulative; totals for the DONE line are kept by hand.
         waits = ds.batch_wait_stats.summary()
+        run_wait_total += waits["total"]
+        run_wait_count += waits["count"]
+        ds.batch_wait_stats.reset()
         print(f"[rank {rank}] epoch {epoch}: {steps} steps in "
               f"{duration:.2f}s ({steps * args.batch_size / duration:,.0f} "
               f"rows/s), loss={last_loss:.4f}, "
               f"batch-wait mean={waits['mean'] * 1e3:.1f}ms "
               f"max={waits['max'] * 1e3:.1f}ms total={waits['total']:.2f}s")
-    waits = ds.batch_wait_stats.summary()
-    print(f"[rank {rank}] DONE: {waits['count']} batches, "
-          f"total stall {waits['total']:.2f}s "
-          f"(mean {waits['mean'] * 1e3:.1f}ms/batch)")
+        epoch_rows.append({
+            "rank": rank, "epoch": epoch, "steps": steps,
+            "duration_s": round(duration, 4),
+            "rows_per_s": round(steps * args.batch_size / duration, 1),
+            "loss": (round(last_loss, 6)
+                     if isinstance(last_loss, float) else ""),
+            "batch_wait_mean_ms": round(waits["mean"] * 1e3, 3),
+            "batch_wait_max_ms": round(waits["max"] * 1e3, 3),
+            "batch_wait_total_s": round(waits["total"], 4),
+        })
+    print(f"[rank {rank}] DONE: {run_wait_count} batches, "
+          f"total stall {run_wait_total:.2f}s "
+          f"(mean {run_wait_total / max(1, run_wait_count) * 1e3:.1f}"
+          "ms/batch)")
+    if args.stats_dir:
+        # Per-host stats CSV — what the slice launcher
+        # (examples/launch_slice.py) gathers after a run (the reference's
+        # per-trial CSVs role, reference: ray_torch_shuffle.py:228-237).
+        import csv
+
+        from ray_shuffling_data_loader_tpu.utils import fileio
+        fileio.makedirs(args.stats_dir)
+        path = fileio.join(args.stats_dir, f"host_{rank}_epochs.csv")
+        with fileio.open_text(path, "w") as f:
+            writer = csv.DictWriter(f, fieldnames=list(epoch_rows[0])
+                                    if epoch_rows else ["rank"])
+            writer.writeheader()
+            for row in epoch_rows:
+                writer.writerow(row)
+        print(f"[rank {rank}] stats written to {path}")
     # Release the persistent prefetch producer (no-op if it already exited
     # after the final epoch).
     ds.close()

@@ -96,6 +96,7 @@ def test_bench_py_json_contract(tmp_path):
     env.update(RSDL_BENCH_CPU="1", RSDL_BENCH_ROWS="20000",
                RSDL_BENCH_FILES="2", RSDL_BENCH_EPOCHS="2",
                RSDL_BENCH_BATCH="2048",
+               RSDL_BENCH_TRAIN_EPOCHS="2", RSDL_BENCH_TRAIN_BATCH="2048",
                RSDL_BENCH_DATA=str(tmp_path / "data"))
     repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
     proc = subprocess.run(
@@ -107,8 +108,44 @@ def test_bench_py_json_contract(tmp_path):
     assert len(json_lines) == 1, proc.stdout
     record = json.loads(json_lines[0])
     for key in ("metric", "value", "unit", "vs_baseline", "stall_pct",
-                "stall_s", "cache_mode", "host_cpus", "timed_epochs"):
+                "stall_s", "cache_mode", "host_cpus", "timed_epochs",
+                # All three phases ride one JSON line: the cached headline,
+                # the cold regime, and the contract metric (stall under a
+                # REAL DLRM train step).
+                "cold_rows_per_sec", "vs_baseline_cached",
+                "stall_pct_under_train", "train_rows_per_sec",
+                "train_step_ms_mean", "train_final_loss"):
         assert key in record, key
     assert record["metric"] == "shuffle_ingest_rows_per_sec_per_chip"
     assert record["unit"] == "rows/s"
     assert record["value"] > 0 and record["vs_baseline"] > 0
+    assert record["cold_rows_per_sec"] > 0
+    assert record["train_rows_per_sec"] > 0
+    # The real-step train phase must actually have trained (finite loss).
+    assert record["train_final_loss"] is not None
+    assert 0 <= record["stall_pct_under_train"] <= 100
+
+
+def test_bench_py_phase_subset(tmp_path):
+    """RSDL_BENCH_PHASES trims phases; a cold-only run keeps the legacy
+    cold headline metric name."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(RSDL_BENCH_CPU="1", RSDL_BENCH_ROWS="20000",
+               RSDL_BENCH_FILES="2", RSDL_BENCH_EPOCHS="2",
+               RSDL_BENCH_BATCH="2048", RSDL_BENCH_PHASES="cold",
+               RSDL_BENCH_DATA=str(tmp_path / "data"))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads([l for l in proc.stdout.splitlines()
+                         if l.startswith("{")][0])
+    assert record["metric"] == "shuffle_ingest_rows_per_sec_per_chip_cold"
+    assert "stall_pct_under_train" not in record
+    assert record["cache_mode"] == "cold"
