@@ -404,24 +404,32 @@ def main() -> None:
                 device_rebatch=device_rebatch,
                 model_size=model_size, microbatch=train_mb,
                 qname="bench-train")
+            loss_txt = (f"{train['final_loss']:.4f}"
+                        if train["final_loss"] is not None else "n/a")
             print(f"# train: {train['rows_per_s']:,.0f} rows/s over "
                   f"{train['batches']} real DLRM micro-steps "
                   f"({train['microbatch']} rows, "
                   f"{train['step_ms_mean']:.2f}ms each), stall "
                   f"{train['stall_pct']:.2f}% "
-                  f"(contract: <=10%), loss={train['final_loss']:.4f}",
+                  f"(contract: <=10%), loss={loss_txt}",
                   file=sys.stderr)
 
-    # Best of two runs: the first warms the page cache, and taking the max
-    # is fairest to the reference on a noisy shared host.
+    # The pandas baseline is a LOADER rate; it only makes sense against an
+    # ingest phase. A train-only run (contract metric alone) skips it — a
+    # compute-gated-over-decode-bound ratio would mean nothing.
     baseline_files = filenames[:max(1, len(filenames) // 4)]
-    baseline_rows_per_s = max(
-        _pandas_reference_baseline(baseline_files,
-                                   num_reducers=max(2, num_reducers // 4),
-                                   batch_size=batch_size)
-        for _ in range(2))
-    print(f"# pandas reference algo: {baseline_rows_per_s:,.0f} rows/s",
-          file=sys.stderr)
+    baseline_rows_per_s = None
+    if cached is not None or cold is not None:
+        # Best of two runs: the first warms the page cache, and taking the
+        # max is fairest to the reference on a noisy shared host.
+        baseline_rows_per_s = max(
+            _pandas_reference_baseline(baseline_files,
+                                       num_reducers=max(2,
+                                                        num_reducers // 4),
+                                       batch_size=batch_size)
+            for _ in range(2))
+        print(f"# pandas reference algo: {baseline_rows_per_s:,.0f} rows/s",
+              file=sys.stderr)
 
     if cached is not None:
         headline, metric = cached, "shuffle_ingest_rows_per_sec_per_chip"
@@ -440,7 +448,9 @@ def main() -> None:
     # vs_baseline is the HONEST ratio: the cold pipeline (decode every
     # epoch) against the pandas reference algorithm, which also pays full
     # decode. The cached ratio is reported separately.
-    if cold is not None:
+    if baseline_rows_per_s is None:
+        vs_baseline = None
+    elif cold is not None:
         vs_baseline = cold["rows_per_s"] / baseline_rows_per_s
     else:
         vs_baseline = headline["rows_per_s"] / baseline_rows_per_s
@@ -449,7 +459,8 @@ def main() -> None:
         "metric": metric,
         "value": round(headline["rows_per_s"], 1),
         "unit": "rows/s",
-        "vs_baseline": round(vs_baseline, 3),
+        "vs_baseline": (round(vs_baseline, 3)
+                        if vs_baseline is not None else None),
         # Headline-phase stall stats (near-zero consumer: stall% ~= 100%
         # is expected there; the contract number is the train phase's).
         "stall_pct": round(headline["stall_pct"], 3),

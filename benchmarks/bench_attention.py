@@ -66,6 +66,56 @@ def naive_attention(q, k, v):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
 
 
+def check_correctness(flash, seq_len: int, b: int, h: int, d: int,
+                      fwd_tol: float = 2e-2, grad_tol: float = 2e-2) -> None:
+    """On-chip correctness gate: max-abs-error of the compiled flash
+    fwd AND bwd against the fp32 XLA reference, asserted, not just
+    printed.
+
+    The interpret-mode pytest suite proves the algorithm; this proves
+    the MOSAIC-COMPILED kernel's numerics on the real device (bf16
+    inputs, fp32 accumulation — tolerance matches the bf16 resolution
+    bound the interpret tests use for bf16 inputs,
+    tests/test_flash_attention.py). Errors are computed on device and
+    fetched as scalars, so the tunnel's host-fetch is the sync point.
+    """
+    shape = (b, h, seq_len, d)
+    kq, kk, kv = jax.random.split(jax.random.key(42), 3)
+    q = jax.random.normal(kq, shape, jnp.bfloat16)
+    k = jax.random.normal(kk, shape, jnp.bfloat16)
+    v = jax.random.normal(kv, shape, jnp.bfloat16)
+
+    @jax.jit
+    def errors(q, k, v):
+        out_f = flash(q, k, v).astype(jnp.float32)
+        out_r = naive_attention(q, k, v)
+        fwd_err = jnp.max(jnp.abs(out_f - out_r))
+        # Grads of a non-trivial scalar (weighted sum keeps the cotangent
+        # dense and non-uniform) through both implementations.
+        w = jax.random.normal(jax.random.key(7), shape, jnp.float32)
+
+        def loss(attn, q, k, v):
+            return jnp.sum(attn(q, k, v).astype(jnp.float32) * w)
+
+        gf = jax.grad(functools.partial(loss, flash), (0, 1, 2))(q, k, v)
+        gr = jax.grad(functools.partial(loss, naive_attention),
+                      (0, 1, 2))(q, k, v)
+        grad_err = jnp.max(jnp.asarray(
+            [jnp.max(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
+             for a, b in zip(gf, gr)]))
+        return fwd_err, grad_err
+
+    fwd_err, grad_err = (float(x) for x in errors(q, k, v))
+    print(f"S={seq_len:>5}  correctness: max|flash-xla| fwd {fwd_err:.3e} "
+          f"(tol {fwd_tol:.0e}), grad {grad_err:.3e} (tol {grad_tol:.0e})")
+    assert fwd_err <= fwd_tol, (
+        f"flash fwd diverges from XLA reference on this backend: "
+        f"{fwd_err} > {fwd_tol}")
+    assert grad_err <= grad_tol, (
+        f"flash bwd diverges from XLA reference on this backend: "
+        f"{grad_err} > {grad_tol}")
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--batch", type=int, default=8)
@@ -77,6 +127,9 @@ def main() -> None:
                         help="pin the CPU backend (smoke runs; the site "
                              "plugin ignores JAX_PLATFORMS env)")
     parser.add_argument("--skip-bert", action="store_true")
+    parser.add_argument("--skip-correctness", action="store_true",
+                        help="skip the on-chip max-error gate (it runs "
+                             "before any timing by default)")
     args = parser.parse_args()
 
     if args.cpu:
@@ -91,7 +144,16 @@ def main() -> None:
     def flash(q, k, v):
         return fa.flash_attention(q, k, v, interpret=interpret)
 
-    for s in map(int, args.seqs.split(",")):
+    seqs = list(map(int, args.seqs.split(",")))
+    if not args.skip_correctness:
+        # Gate timings on numerics: the compiled kernel must match the
+        # XLA reference on THIS backend before its speed means anything.
+        # The largest S bounds accumulation-order divergence; S=512 also
+        # covers the multi-block fwd path at small shapes.
+        for s in sorted({seqs[0], seqs[-1]}):
+            check_correctness(flash, s, b, h, d)
+
+    for s in seqs:
         shape = (b, h, s, d)
 
         def gen(key):

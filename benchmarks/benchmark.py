@@ -53,7 +53,10 @@ def run_trials(num_epochs: int,
                trials_timeout: Optional[float] = None,
                seed: int = 0,
                map_transform=None,
-               reduce_transform=None) -> List[Tuple]:
+               reduce_transform=None,
+               file_cache="auto",
+               max_inflight_bytes: Optional[int] = None,
+               spill_dir: Optional[str] = None) -> List[Tuple]:
     """Run fixed-count or time-bounded trials
     (reference: benchmark.py:26-68)."""
     all_stats = []
@@ -64,7 +67,8 @@ def run_trials(num_epochs: int,
                 num_epochs, filenames, num_reducers, num_trainers,
                 max_concurrent_epochs, collect_stats,
                 utilization_sample_period, seed + trial,
-                map_transform, reduce_transform)
+                map_transform, reduce_transform, file_cache,
+                max_inflight_bytes, spill_dir)
             _log_trial(trial, stats)
             all_stats.append((stats, store_stats))
     elif trials_timeout is not None:
@@ -76,7 +80,8 @@ def run_trials(num_epochs: int,
                 num_epochs, filenames, num_reducers, num_trainers,
                 max_concurrent_epochs, collect_stats,
                 utilization_sample_period, seed + trial,
-                map_transform, reduce_transform)
+                map_transform, reduce_transform, file_cache,
+                max_inflight_bytes, spill_dir)
             _log_trial(trial, stats)
             all_stats.append((stats, store_stats))
             trial += 1
@@ -88,17 +93,22 @@ def run_trials(num_epochs: int,
 def _one_trial(num_epochs, filenames, num_reducers, num_trainers,
                max_concurrent_epochs, collect_stats,
                utilization_sample_period, seed,
-               map_transform=None, reduce_transform=None):
+               map_transform=None, reduce_transform=None,
+               file_cache="auto", max_inflight_bytes=None, spill_dir=None):
     if collect_stats:
         return shuffle_with_stats(
             filenames, dummy_batch_consumer, num_epochs, num_reducers,
             num_trainers, max_concurrent_epochs, seed=seed,
             utilization_sample_period=utilization_sample_period,
-            map_transform=map_transform, reduce_transform=reduce_transform)
+            map_transform=map_transform, reduce_transform=reduce_transform,
+            file_cache=file_cache, max_inflight_bytes=max_inflight_bytes,
+            spill_dir=spill_dir)
     return shuffle_no_stats(
         filenames, dummy_batch_consumer, num_epochs, num_reducers,
         num_trainers, max_concurrent_epochs, seed=seed,
-        map_transform=map_transform, reduce_transform=reduce_transform)
+        map_transform=map_transform, reduce_transform=reduce_transform,
+        file_cache=file_cache, max_inflight_bytes=max_inflight_bytes,
+        spill_dir=spill_dir)
 
 
 def _log_trial(trial, stats):
@@ -143,6 +153,16 @@ def parse_args(argv=None):
                         help="imagenet workload: square image edge length")
     parser.add_argument("--seq-len", type=int, default=128,
                         help="bert workload: tokens per row")
+    parser.add_argument("--cold", action="store_true",
+                        help="disable the cross-epoch file-table cache: "
+                             "every epoch re-reads + re-decodes Parquet "
+                             "(the reference's corpus->RAM regime)")
+    parser.add_argument("--max-inflight-bytes", type=int, default=None,
+                        help="transient pipeline byte budget; the driver "
+                             "throttles epoch launches against it")
+    parser.add_argument("--spill-dir", type=str, default=None,
+                        help="with --max-inflight-bytes: spill over-budget "
+                             "reducer outputs to Arrow IPC files here")
     args = parser.parse_args(argv)
     if args.num_trials is None and args.trials_timeout is None:
         args.num_trials = 3
@@ -224,7 +244,10 @@ def main(argv=None) -> None:
         utilization_sample_period=args.utilization_sample_period,
         num_trials=args.num_trials, trials_timeout=args.trials_timeout,
         seed=args.seed, map_transform=map_transform,
-        reduce_transform=reduce_transform)
+        reduce_transform=reduce_transform,
+        file_cache=None if args.cold else "auto",
+        max_inflight_bytes=args.max_inflight_bytes,
+        spill_dir=args.spill_dir)
 
     if args.no_stats:
         durations = [d for d, _ in all_stats]

@@ -14,6 +14,35 @@ TRIALS="${SWEEP_TRIALS:-2}"
 DATA_DIR="${SWEEP_DATA_DIR:-./benchmark_data}"
 STATS_DIR="${SWEEP_STATS_DIR:-./results}"
 
+# SWEEP_SPILL_SOAK=1: one reference-regime point with the byte-budget
+# machinery fully engaged — cold mode (decode every epoch, the 64 GB-corpus
+# operating regime of the reference sweep), a transient-byte budget far
+# below what max_concurrent_epochs x corpus would otherwise hold, and the
+# disk spill tier active. SWEEP_MAX_INFLIGHT_BYTES / SWEEP_SPILL_DIR size
+# it (defaults: 1 GiB budget, spill under the data dir).
+if [ "${SWEEP_SPILL_SOAK:-0}" = "1" ]; then
+  BUDGET="${SWEEP_MAX_INFLIGHT_BYTES:-1073741824}"
+  SPILL_DIR="${SWEEP_SPILL_DIR:-$DATA_DIR/spill}"
+  echo "=== spill soak: rows=$ROWS budget=$BUDGET cold=1 spill=$SPILL_DIR ==="
+  python benchmarks/benchmark.py \
+    --num-rows "$ROWS" \
+    --num-files "${SWEEP_FILES:-25}" \
+    --num-row-groups-per-file 5 \
+    --num-reducers "${SWEEP_REDUCERS:-8}" \
+    --num-trainers "${SWEEP_TRAINERS:-4}" \
+    --num-epochs "$EPOCHS" \
+    --batch-size "$BATCH" \
+    --max-concurrent-epochs 2 \
+    --num-trials "$TRIALS" \
+    --data-dir "$DATA_DIR" \
+    --stats-dir "$STATS_DIR" \
+    --cold \
+    --max-inflight-bytes "$BUDGET" \
+    --spill-dir "$SPILL_DIR" \
+    --overwrite-stats --unique-stats
+  exit 0
+fi
+
 first=1
 for files in 100 50 25; do
   for trainers in 16 8 4; do

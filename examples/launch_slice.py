@@ -117,6 +117,9 @@ def main(argv=None) -> int:
     remote_repo = args.repo or repo_dir
     coordinator = (f"{endpoints[0].rsplit(':', 1)[0]}:"
                    f"{args.coordinator_port}")
+    # Children run with cwd=repo_dir, so a relative --out must be resolved
+    # against the LAUNCHER's cwd or local-mode CSVs land inside the repo.
+    args.out = os.path.abspath(args.out)
     os.makedirs(args.out, exist_ok=True)
 
     procs = []
@@ -152,8 +155,12 @@ def main(argv=None) -> int:
             remote = (f"cd {shlex.quote(remote_repo)} && rm -f {stale} && "
                       f"{exports} "
                       + " ".join(shlex.quote(c) for c in train_cmd))
+            # -tt forces a pty: killing the local ssh client then HUPs the
+            # remote session, so "stop them (peer failed)" actually stops
+            # the remote trainer instead of only the local client.
             proc = subprocess.Popen(
-                ["ssh", "-o", "BatchMode=yes", ssh_targets[i], remote],
+                ["ssh", "-tt", "-o", "BatchMode=yes", ssh_targets[i],
+                 remote],
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True)
         t = threading.Thread(target=_stream, args=(proc, f"host {i}"),

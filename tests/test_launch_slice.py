@@ -1,0 +1,48 @@
+"""Tests for the multi-host slice launcher (examples/launch_slice.py):
+argument handling and the local fan-out path's env wiring."""
+
+import importlib.util
+import os
+import sys
+
+import pytest
+
+_spec = importlib.util.spec_from_file_location(
+    "launch_slice",
+    os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                 "examples", "launch_slice.py"))
+launch_slice = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(launch_slice)
+
+
+def test_parse_splits_train_args_at_double_dash():
+    args = launch_slice.parse_args(
+        ["--local", "--out", "/tmp/x", "--",
+         "--cpu", "--num-rows", "4096"])
+    assert args.local
+    assert args.out == "/tmp/x"
+    assert args.train_args == ["--cpu", "--num-rows", "4096"]
+
+
+def test_parse_no_train_args():
+    args = launch_slice.parse_args(["--local"])
+    assert args.train_args == []
+
+
+def test_requires_rsdl_hosts(monkeypatch, capsys):
+    monkeypatch.delenv("RSDL_HOSTS", raising=False)
+    assert launch_slice.main(["--local"]) == 2
+    assert "RSDL_HOSTS is required" in capsys.readouterr().err
+
+
+def test_rejects_mismatched_ssh_targets(monkeypatch, capsys):
+    monkeypatch.setenv("RSDL_HOSTS", "a:1,b:2,c:3")
+    rc = launch_slice.main(["--ssh", "hostA,hostB"])
+    assert rc == 2
+    assert "3 endpoints" in capsys.readouterr().err
+
+
+def test_rejects_local_plus_ssh(monkeypatch, capsys):
+    monkeypatch.setenv("RSDL_HOSTS", "a:1")
+    assert launch_slice.main(["--local", "--ssh", "x"]) == 2
+    assert "mutually exclusive" in capsys.readouterr().err
