@@ -158,9 +158,13 @@ def main(argv=None) -> int:
             # -tt forces a pty: killing the local ssh client then HUPs the
             # remote session, so "stop them (peer failed)" actually stops
             # the remote trainer instead of only the local client.
+            # stdin=DEVNULL: -tt must not adopt (and raw-mode) the
+            # launcher's own tty — killing ssh on the peer-failure path
+            # would leave the user's terminal without echo.
             proc = subprocess.Popen(
                 ["ssh", "-tt", "-o", "BatchMode=yes", ssh_targets[i],
                  remote],
+                stdin=subprocess.DEVNULL,
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True)
         t = threading.Thread(target=_stream, args=(proc, f"host {i}"),
