@@ -161,15 +161,16 @@ def run_ingest(jax, filenames, *, num_epochs, batch_size, num_reducers,
         ds.set_epoch(epoch)
         for features, label in ds:
             if fill_s is None:
-                now = timeit.default_timer()
-                fill_s = now - launch
+                fill_s = timeit.default_timer() - launch
                 if start is None:
-                    # Cached: clock + stall stats start at first
-                    # delivery; the first batch itself (produced
-                    # pre-window) is not counted.
-                    start = now
-                    ds.batch_wait_stats.reset()
+                    # Cached: the first batch (produced pre-window) is
+                    # consumed BEFORE the clock starts, so neither its
+                    # production nor its consumption leaks into the
+                    # window; stall stats start with batch 2's wait.
                     last = touch(features, label)
+                    jax.block_until_ready(last)
+                    ds.batch_wait_stats.reset()
+                    start = timeit.default_timer()
                     continue
             last = touch(features, label)
             if step_ms:
@@ -289,14 +290,16 @@ def run_train(jax, filenames, *, num_epochs, batch_size, num_reducers,
         ds.set_epoch(epoch)
         for features, label in ds:
             if start is None:
-                start = timeit.default_timer()
-                fill_s = start - launch
-                ds.batch_wait_stats.reset()
-                # The first chunk still trains (params advance), it just
-                # isn't counted — it was produced pre-window.
+                fill_s = timeit.default_timer() - launch
+                # The first chunk (produced pre-window) trains BEFORE the
+                # clock starts: params advance, but neither its
+                # production nor its compute is inside the window.
                 for i in range(steps_per_chunk):
                     params, opt_state, loss = micro_step(
                         params, opt_state, features, label, np.int32(i))
+                jax.block_until_ready(loss)
+                ds.batch_wait_stats.reset()
+                start = timeit.default_timer()
                 continue
             for i in range(steps_per_chunk):
                 params, opt_state, loss = micro_step(

@@ -18,7 +18,8 @@ from ray_shuffling_data_loader_tpu.dataset import (  # noqa: E402,F401
     ShufflingDataset, create_batch_queue_and_shuffle)
 from ray_shuffling_data_loader_tpu.jax_dataset import (  # noqa: E402,F401
     JaxShufflingDataset)
-from ray_shuffling_data_loader_tpu.multiqueue import MultiQueue  # noqa: E402,F401
+from ray_shuffling_data_loader_tpu.multiqueue import (  # noqa: E402,F401
+    Empty, Full, MultiQueue, ShutdownError)
 from ray_shuffling_data_loader_tpu.multiqueue_service import (  # noqa: E402,F401
     RemoteQueue, serve_queue)
 from ray_shuffling_data_loader_tpu.shuffle import (  # noqa: E402,F401
@@ -31,6 +32,9 @@ __all__ = [
     "ShufflingDataset",
     "JaxShufflingDataset",
     "MultiQueue",
+    "Empty",
+    "Full",
+    "ShutdownError",
     "RemoteQueue",
     "serve_queue",
     "shuffle",

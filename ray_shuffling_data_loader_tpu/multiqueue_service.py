@@ -292,6 +292,14 @@ class RemoteQueue:
         buf.extend(items)
         if items and self._epoch_over(items[-1]):
             self._done.add(queue_index)
+        elif self._prefetch and queue_index not in self._pending:
+            # Submit the NEXT batched request as soon as this one lands,
+            # so the wire round trip overlaps the consumption of the
+            # whole freshly-buffered batch (costs one extra batch of
+            # client-side buffering); waiting until the buffer drained
+            # would overlap only the last item's consumption.
+            self._pending[queue_index] = self._io.submit(
+                self._fetch_batch, queue_index)
 
     def get(self, queue_index: int, block: bool = True):
         if not block:
@@ -315,11 +323,6 @@ class RemoteQueue:
                     self._state_lock.acquire()
                 self._ingest(queue_index, items)
             item = buf.popleft()
-            if (self._prefetch and not buf
-                    and queue_index not in self._done
-                    and queue_index not in self._pending):
-                self._pending[queue_index] = self._io.submit(
-                    self._fetch_batch, queue_index)
         return item
 
     def close(self) -> None:
