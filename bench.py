@@ -545,6 +545,9 @@ def main() -> None:
         # was a many-core host; a 1-core host sustains ~4M.)
         "host_cpus": os.cpu_count(),
         "timed_epochs": headline["timed_epochs"],
+        # Launch-to-first-delivery latency of the headline phase (outside
+        # the timed window for cached/train, inside it for cold).
+        "fill_s": round(headline.get("fill_s", 0.0), 3),
     }
     if cached is not None:
         record["vs_baseline_cached"] = round(
@@ -554,6 +557,7 @@ def main() -> None:
             "cold_rows_per_sec": round(cold["rows_per_s"], 1),
             "cold_stall_pct": round(cold["stall_pct"], 3),
             "cold_timed_epochs": cold["timed_epochs"],
+            "cold_fill_s": round(cold.get("fill_s", 0.0), 3),
         })
     if train is not None:
         record.update({
@@ -567,6 +571,7 @@ def main() -> None:
             "train_steps": train["batches"],
             "train_stall_s": round(train["stall_s"], 3),
             "train_wait_mean_ms": round(train["wait_mean_ms"], 3),
+            "train_fill_s": round(train.get("fill_s", 0.0), 3),
             "train_final_loss": (round(train["final_loss"], 5)
                                  if train["final_loss"] is not None
                                  else None),
