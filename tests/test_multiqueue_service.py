@@ -196,3 +196,58 @@ def test_jax_dataset_over_remote_queue_device_rebatch(tmp_parquet_dir):
         for x, y in zip(fa, fb):
             np.testing.assert_array_equal(x, y)
         np.testing.assert_array_equal(la, lb)
+
+
+def test_two_remote_trainer_ranks_drain_their_own_queues(tmp_parquet_dir):
+    """The reference's multi-GPU topology over the wire: two trainer
+    ranks, each with its OWN RemoteQueue connection, drain their own
+    per-rank queues of one shuffle concurrently — every key exactly once
+    across the pair, none crossing ranks (queue id contract
+    epoch*num_trainers+rank, reference: dataset.py:173)."""
+    filenames, _ = dg.generate_data_local(300, 3, 1, 0.0, tmp_parquet_dir)
+    num_epochs = 2
+    queue, shuffle_result = create_batch_queue_and_shuffle(
+        filenames, num_epochs, num_trainers=2, batch_size=25,
+        max_concurrent_epochs=2, num_reducers=4, seed=13,
+        queue_name="svc-two-ranks")
+    per_rank: dict = {}
+    errors: list = []
+    with svc.serve_queue(queue) as server:
+
+        def consume(rank: int) -> None:
+            try:
+                with svc.RemoteQueue(server.address, max_batch=3) as remote:
+                    ds = ShufflingDataset(
+                        filenames, num_epochs, num_trainers=2,
+                        batch_size=25, rank=rank, batch_queue=remote,
+                        shuffle_result=None, seed=13)
+                    for epoch in range(num_epochs):
+                        ds.set_epoch(epoch)
+                        keys = []
+                        for batch in ds:
+                            keys.extend(
+                                batch.column(dg.KEY_COLUMN).to_pylist())
+                        per_rank[(rank, epoch)] = keys
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        # daemon=True + shutdown in finally: a genuinely hung rank must
+        # fail the test, not strand a non-daemon thread blocked in
+        # socket recv that keeps pytest alive forever at exit.
+        threads = [threading.Thread(target=consume, args=(r,), daemon=True)
+                   for r in range(2)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive(), "remote rank hung"
+        finally:
+            queue.shutdown()
+    if errors:
+        raise errors[0]
+    for epoch in range(num_epochs):
+        union = sorted(per_rank[(0, epoch)] + per_rank[(1, epoch)])
+        assert union == list(range(300)), f"epoch {epoch} coverage broken"
+        assert per_rank[(0, epoch)] and per_rank[(1, epoch)]
+    shuffle_result.result()
