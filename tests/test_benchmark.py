@@ -149,3 +149,37 @@ def test_bench_py_phase_subset(tmp_path):
     assert record["metric"] == "shuffle_ingest_rows_per_sec_per_chip_cold"
     assert "stall_pct_under_train" not in record
     assert record["cache_mode"] == "cold"
+
+
+def test_run_ingest_phase_dict_contract(tmp_path):
+    """run_ingest returns the phase-dict fields main() assembles into the
+    JSON record, for both clock modes (cached: from first delivery;
+    cold: end-to-end from launch)."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(repo, "bench.py"))
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+
+    import jax
+
+    from ray_shuffling_data_loader_tpu import data_generation as dg
+
+    filenames, _ = dg.generate_data_local(8000, 2, 1, 0.0, str(tmp_path))
+    for cold in (False, True):
+        r = bench_mod.run_ingest(
+            jax, filenames, num_epochs=2, batch_size=1000,
+            num_reducers=2, prefetch_size=2, cold=cold,
+            device_rebatch=False, step_ms=0,
+            qname=f"ingest-contract-{cold}")
+        for key in ("rows_per_s", "stall_s", "stall_pct", "wait_mean_ms",
+                    "batches", "timed_epochs", "duration_s", "fill_s"):
+            assert key in r, (cold, key)
+        assert r["rows_per_s"] > 0
+        assert r["timed_epochs"] == 2
+        assert r["fill_s"] > 0
+        if cold:
+            # Cold clocks from launch: the window contains the fill.
+            assert r["duration_s"] >= r["fill_s"]
