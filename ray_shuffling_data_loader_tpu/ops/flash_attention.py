@@ -227,6 +227,15 @@ def _pick_aligned_block(seq: int, preferred: int, align: int) -> int:
     return block
 
 
+# Default VMEM tile sizes, shared by every public entry point here and by
+# the ring-attention flash hops (ops/ring_attention.py) — retune in ONE
+# place. From the round-4 on-chip sweep (v5e, D=64): bq=512/bk=1024 beat
+# 512/512 by ~14% fwd+bwd at S=2048-4096; blocks clamp to S, so small-S
+# kernels are unchanged.
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 1024
+
+
 def _plan(sq: int, sk: int, block_q: int, block_k: int, interpret: bool):
     """(bq, bk, sq_pad, sk_pad). Interpret mode: any divisor works.
     TPU: blocks must be (8, 128)-tile aligned, so pad the sequence dims
@@ -323,8 +332,8 @@ def flash_attention(q: jax.Array,
                     k: jax.Array,
                     v: jax.Array,
                     bias: Optional[jax.Array] = None,
-                    block_q: int = 512,
-                    block_k: int = 1024,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
                     interpret: bool = False) -> jax.Array:
     """Exact attention via the Pallas flash kernels.
 
@@ -346,8 +355,8 @@ def flash_attention(q: jax.Array,
     return out
 
 
-def flash_forward(q, k, v, bias=None, block_q: int = 512,
-                  block_k: int = 1024, interpret: bool = False):
+def flash_forward(q, k, v, bias=None, block_q: int = DEFAULT_BLOCK_Q,
+                  block_k: int = DEFAULT_BLOCK_K, interpret: bool = False):
     """Forward kernels only: returns ``(out, lse)`` with lse
     (B, H, Sq, 1) float32 — the partial-softmax residual ring attention
     needs to merge per-hop results (ops/ring_attention.py)."""
@@ -365,8 +374,8 @@ def _flash_bwd(block_q, block_k, interpret, residuals, do):
                           interpret)
 
 
-def flash_backward(q, k, v, bias, out, lse, do, block_q: int = 512,
-                   block_k: int = 1024, interpret: bool = False):
+def flash_backward(q, k, v, bias, out, lse, do, block_q: int = DEFAULT_BLOCK_Q,
+                   block_k: int = DEFAULT_BLOCK_K, interpret: bool = False):
     """Backward kernels: ``(dq, dk, dv, dbias)`` from the standard flash
     residuals. ``lse`` may be global (covering MORE keys than ``k``) — the
     ring backward exploits this: with the global logsumexp, the recomputed
@@ -468,8 +477,8 @@ FLASH_MIN_SEQ_LEN = 1024
 
 
 def auto_attention_fn(seq_len: int,
-                      block_q: int = 512,
-                      block_k: int = 1024):
+                      block_q: int = DEFAULT_BLOCK_Q,
+                      block_k: int = DEFAULT_BLOCK_K):
     """The measured-best attention for ``seq_len`` on this backend.
 
     Returns a flash ``attention_fn`` when running on TPU with
@@ -484,8 +493,8 @@ def auto_attention_fn(seq_len: int,
     return None
 
 
-def make_flash_attention_fn(block_q: int = 512,
-                            block_k: int = 1024,
+def make_flash_attention_fn(block_q: int = DEFAULT_BLOCK_Q,
+                            block_k: int = DEFAULT_BLOCK_K,
                             interpret: Optional[bool] = None):
     """An ``attention_fn(q, k, v, bias)`` closure for models/bert.py.
 

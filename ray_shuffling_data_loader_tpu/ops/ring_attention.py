@@ -318,9 +318,15 @@ _ring_attention_prim.defvjp(_ring_prim_fwd, _ring_prim_bwd)
 
 
 def _ring_attention_shard(q, k, v, kv_bias, axis_name: str, causal: bool,
-                          use_flash: bool = False, block_q: int = 128,
-                          block_k: int = 128, interpret: bool = False):
+                          use_flash: bool = False,
+                          block_q: Optional[int] = None,
+                          block_k: Optional[int] = None,
+                          interpret: bool = False):
     """Per-shard ring attention body; must run under shard_map/pmap.
+
+    Block defaults come from flash_attention's DEFAULT_BLOCK_Q/K (one
+    retuning site); the kernel clamps blocks to the per-hop shard
+    length, so small S/world shards compile exactly as before.
 
     q/k/v: (B, H, S_local, D) — this device's sequence chunk. kv_bias:
     (B, 1, 1, S_local) additive key-side bias or None. K/V (+bias) rotate
@@ -333,6 +339,11 @@ def _ring_attention_shard(q, k, v, kv_bias, axis_name: str, causal: bool,
     even the per-hop local score tensor (non-causal only).
     """
     if use_flash:
+        from ray_shuffling_data_loader_tpu.ops import flash_attention as fa
+        if block_q is None:
+            block_q = fa.DEFAULT_BLOCK_Q
+        if block_k is None:
+            block_k = fa.DEFAULT_BLOCK_K
         return _ring_flash_prim(axis_name, block_q, block_k, interpret,
                                 q, k, v, kv_bias)
     return _ring_attention_prim(axis_name, causal, q, k, v, kv_bias)
