@@ -471,8 +471,9 @@ flash_attention.defvjp(_flash_fwd, _flash_bwd)
 
 
 # Below this sequence length XLA's fused attention ties or beats the
-# Pallas kernels on-chip (round-3 bench_attention.py: parity at S=512,
-# flash ahead from S=1024 — 2.19x fwd+bwd at S=4096).
+# Pallas kernels on-chip (round-4 bench_attention.py with the retuned
+# blocks: 0.89x fwd+bwd at S=512, flash ahead from S=1024 — 1.75x
+# there, 2.48x at S=4096).
 FLASH_MIN_SEQ_LEN = 1024
 
 
