@@ -137,17 +137,22 @@ def run_ingest(jax, filenames, *, num_epochs, batch_size, num_reducers,
         lambda fs, y: sum(f.sum(dtype=jnp.int32) for f in fs)
         + y.sum(dtype=jnp.float32))
 
+    # try/finally on both dataset lifetimes: a phase that raises must not
+    # leave its producers/queues running to contaminate later phases (the
+    # caller treats phase failures as non-fatal).
     warm = _make_dataset(filenames, num_epochs=1, batch_size=batch_size,
                          num_reducers=num_reducers,
                          prefetch_size=prefetch_size, cold=cold,
                          device_rebatch=device_rebatch,
                          qname=f"{qname}-warm")
-    warm.set_epoch(0)
-    last = None
-    for features, label in warm:
-        last = touch(features, label)
-    jax.block_until_ready(last)
-    warm.close()
+    try:
+        warm.set_epoch(0)
+        last = None
+        for features, label in warm:
+            last = touch(features, label)
+        jax.block_until_ready(last)
+    finally:
+        warm.close()
 
     launch = timeit.default_timer()
     ds = _make_dataset(filenames, num_epochs=num_epochs,
@@ -157,28 +162,30 @@ def run_ingest(jax, filenames, *, num_epochs, batch_size, num_reducers,
     rows_consumed = 0
     start = launch if cold else None  # cold: launch-to-last-batch
     fill_s = None
-    for epoch in range(num_epochs):
-        ds.set_epoch(epoch)
-        for features, label in ds:
-            if fill_s is None:
-                fill_s = timeit.default_timer() - launch
-                if start is None:
-                    # Cached: the first batch (produced pre-window) is
-                    # consumed BEFORE the clock starts, so neither its
-                    # production nor its consumption leaks into the
-                    # window; stall stats start with batch 2's wait.
-                    last = touch(features, label)
-                    jax.block_until_ready(last)
-                    ds.batch_wait_stats.reset()
-                    start = timeit.default_timer()
-                    continue
-            last = touch(features, label)
-            if step_ms:
-                time.sleep(step_ms / 1e3)
-            rows_consumed += label.shape[0]
-    jax.block_until_ready(last)
-    duration = max(timeit.default_timer() - (start or launch), 1e-9)
-    ds.close()
+    try:
+        for epoch in range(num_epochs):
+            ds.set_epoch(epoch)
+            for features, label in ds:
+                if fill_s is None:
+                    fill_s = timeit.default_timer() - launch
+                    if start is None:
+                        # Cached: the first batch (produced pre-window) is
+                        # consumed BEFORE the clock starts, so neither its
+                        # production nor its consumption leaks into the
+                        # window; stall stats start with batch 2's wait.
+                        last = touch(features, label)
+                        jax.block_until_ready(last)
+                        ds.batch_wait_stats.reset()
+                        start = timeit.default_timer()
+                        continue
+                last = touch(features, label)
+                if step_ms:
+                    time.sleep(step_ms / 1e3)
+                rows_consumed += label.shape[0]
+        jax.block_until_ready(last)
+        duration = max(timeit.default_timer() - (start or launch), 1e-9)
+    finally:
+        ds.close()
     wait = ds.batch_wait_stats.summary()
     return {
         "rows_per_s": rows_consumed / duration,
@@ -264,19 +271,22 @@ def run_train(jax, filenames, *, num_epochs, batch_size, num_reducers,
     # start at its FIRST chunk delivery (the reference's trainers attach
     # to an already-running shuffle, so they never observe launch fill —
     # reported separately as fill_s).
+    # try/finally on both dataset lifetimes — see run_ingest.
     warm = _make_dataset(filenames, num_epochs=1, batch_size=batch_size,
                          num_reducers=num_reducers,
                          prefetch_size=prefetch_size, cold=False,
                          device_rebatch=device_rebatch,
                          qname=f"{qname}-warm")
-    warm.set_epoch(0)
-    loss = None
-    for features, label in warm:
-        for i in range(steps_per_chunk):
-            params, opt_state, loss = micro_step(
-                params, opt_state, features, label, np.int32(i))
-    jax.block_until_ready(loss)
-    warm.close()
+    try:
+        warm.set_epoch(0)
+        loss = None
+        for features, label in warm:
+            for i in range(steps_per_chunk):
+                params, opt_state, loss = micro_step(
+                    params, opt_state, features, label, np.int32(i))
+        jax.block_until_ready(loss)
+    finally:
+        warm.close()
 
     launch = timeit.default_timer()
     ds = _make_dataset(filenames, num_epochs=num_epochs,
@@ -286,29 +296,31 @@ def run_train(jax, filenames, *, num_epochs, batch_size, num_reducers,
     rows_consumed = 0
     steps = 0
     start = fill_s = None
-    for epoch in range(num_epochs):
-        ds.set_epoch(epoch)
-        for features, label in ds:
-            if start is None:
-                fill_s = timeit.default_timer() - launch
-                # The first chunk (produced pre-window) trains BEFORE the
-                # clock starts: params advance, but neither its
-                # production nor its compute is inside the window.
+    try:
+        for epoch in range(num_epochs):
+            ds.set_epoch(epoch)
+            for features, label in ds:
+                if start is None:
+                    fill_s = timeit.default_timer() - launch
+                    # The first chunk (produced pre-window) trains BEFORE
+                    # the clock starts: params advance, but neither its
+                    # production nor its compute is inside the window.
+                    for i in range(steps_per_chunk):
+                        params, opt_state, loss = micro_step(
+                            params, opt_state, features, label, np.int32(i))
+                    jax.block_until_ready(loss)
+                    ds.batch_wait_stats.reset()
+                    start = timeit.default_timer()
+                    continue
                 for i in range(steps_per_chunk):
                     params, opt_state, loss = micro_step(
                         params, opt_state, features, label, np.int32(i))
-                jax.block_until_ready(loss)
-                ds.batch_wait_stats.reset()
-                start = timeit.default_timer()
-                continue
-            for i in range(steps_per_chunk):
-                params, opt_state, loss = micro_step(
-                    params, opt_state, features, label, np.int32(i))
-                rows_consumed += mb
-                steps += 1
-    jax.block_until_ready(loss)
-    duration = max(timeit.default_timer() - (start or launch), 1e-9)
-    ds.close()
+                    rows_consumed += mb
+                    steps += 1
+        jax.block_until_ready(loss)
+        duration = max(timeit.default_timer() - (start or launch), 1e-9)
+    finally:
+        ds.close()
     wait = ds.batch_wait_stats.summary()
     stall_s = wait["total"]
     return {
@@ -432,29 +444,44 @@ def main() -> None:
     from ray_shuffling_data_loader_tpu.utils.tracing import maybe_profile
 
     cached = cold = train = None
+
+    def _phase(name, fn):
+        """Run one phase; a failed phase is reported and OMITTED from the
+        JSON instead of killing the whole artifact (the headline fallback
+        below already handles missing phases). If every phase fails, the
+        no-phase exit path fires."""
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - the artifact must survive
+            print(f"# {name} phase FAILED: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+            return None
+
     with maybe_profile():
         if "cached" in phases:
-            cached = run_ingest(
+            cached = _phase("cached", lambda: run_ingest(
                 jax, filenames, num_epochs=num_epochs,
                 batch_size=batch_size, num_reducers=num_reducers,
                 prefetch_size=prefetch_size, cold=False,
                 device_rebatch=device_rebatch, step_ms=step_ms,
-                qname="bench-cached")
-            print(f"# cached: {cached['rows_per_s']:,.0f} rows/s, stall "
-                  f"{cached['stall_pct']:.2f}% over {cached['batches']} "
-                  "batches", file=sys.stderr)
+                qname="bench-cached"))
+            if cached is not None:
+                print(f"# cached: {cached['rows_per_s']:,.0f} rows/s, stall "
+                      f"{cached['stall_pct']:.2f}% over {cached['batches']} "
+                      "batches", file=sys.stderr)
         if "cold" in phases:
             cold_epochs = int(os.environ.get("RSDL_BENCH_COLD_EPOCHS",
                                              min(4, num_epochs)))
-            cold = run_ingest(
+            cold = _phase("cold", lambda: run_ingest(
                 jax, filenames, num_epochs=cold_epochs,
                 batch_size=batch_size, num_reducers=num_reducers,
                 prefetch_size=prefetch_size, cold=True,
                 device_rebatch=device_rebatch, step_ms=step_ms,
-                qname="bench-cold")
-            print(f"# cold: {cold['rows_per_s']:,.0f} rows/s, stall "
-                  f"{cold['stall_pct']:.2f}% over {cold['batches']} "
-                  "batches", file=sys.stderr)
+                qname="bench-cold"))
+            if cold is not None:
+                print(f"# cold: {cold['rows_per_s']:,.0f} rows/s, stall "
+                      f"{cold['stall_pct']:.2f}% over {cold['batches']} "
+                      "batches", file=sys.stderr)
         if "train" in phases:
             train_epochs = int(os.environ.get("RSDL_BENCH_TRAIN_EPOCHS", 4))
             train_batch = int(os.environ.get("RSDL_BENCH_TRAIN_BATCH",
@@ -464,23 +491,24 @@ def main() -> None:
                 "tiny" if os.environ.get("RSDL_BENCH_CPU") else "mlperf")
             train_mb = int(os.environ.get("RSDL_BENCH_TRAIN_MICROBATCH",
                                           2048))
-            train = run_train(
+            train = _phase("train", lambda: run_train(
                 jax, filenames, num_epochs=train_epochs,
                 batch_size=train_batch,
                 num_reducers=num_reducers,
                 prefetch_size=prefetch_size,
                 device_rebatch=device_rebatch,
                 model_size=model_size, microbatch=train_mb,
-                qname="bench-train")
-            loss_txt = (f"{train['final_loss']:.4f}"
-                        if train["final_loss"] is not None else "n/a")
-            print(f"# train: {train['rows_per_s']:,.0f} rows/s over "
-                  f"{train['batches']} real DLRM micro-steps "
-                  f"({train['microbatch']} rows, "
-                  f"{train['step_ms_mean']:.2f}ms each), stall "
-                  f"{train['stall_pct']:.2f}% "
-                  f"(contract: <=10%), loss={loss_txt}",
-                  file=sys.stderr)
+                qname="bench-train"))
+            if train is not None:
+                loss_txt = (f"{train['final_loss']:.4f}"
+                            if train["final_loss"] is not None else "n/a")
+                print(f"# train: {train['rows_per_s']:,.0f} rows/s over "
+                      f"{train['batches']} real DLRM micro-steps "
+                      f"({train['microbatch']} rows, "
+                      f"{train['step_ms_mean']:.2f}ms each), stall "
+                      f"{train['stall_pct']:.2f}% "
+                      f"(contract: <=10%), loss={loss_txt}",
+                      file=sys.stderr)
 
     # The pandas baseline is a LOADER rate; it only makes sense against an
     # ingest phase. A train-only run (contract metric alone) skips it — a
@@ -489,15 +517,17 @@ def main() -> None:
     baseline_rows_per_s = None
     if cached is not None or cold is not None:
         # Best of two runs: the first warms the page cache, and taking the
-        # max is fairest to the reference on a noisy shared host.
-        baseline_rows_per_s = max(
-            _pandas_reference_baseline(baseline_files,
-                                       num_reducers=max(2,
-                                                        num_reducers // 4),
-                                       batch_size=batch_size)
-            for _ in range(2))
-        print(f"# pandas reference algo: {baseline_rows_per_s:,.0f} rows/s",
-              file=sys.stderr)
+        # max is fairest to the reference on a noisy shared host. Failure
+        # here must not destroy the already-measured phases: the ratio is
+        # then omitted (vs_baseline: null), not the artifact.
+        baseline_rows_per_s = _phase(
+            "pandas-baseline",
+            lambda: max(_pandas_reference_baseline(
+                baseline_files, num_reducers=max(2, num_reducers // 4),
+                batch_size=batch_size) for _ in range(2)))
+        if baseline_rows_per_s is not None:
+            print(f"# pandas reference algo: "
+                  f"{baseline_rows_per_s:,.0f} rows/s", file=sys.stderr)
 
     if cached is not None:
         headline, metric = cached, "shuffle_ingest_rows_per_sec_per_chip"
@@ -509,7 +539,9 @@ def main() -> None:
         # phase runs with the cache ON, so the cold metric name would lie).
         headline, metric = train, "train_gated_rows_per_sec_per_chip"
     else:
-        print(f"RSDL_BENCH_PHASES={phases!r} selected no phase",
+        print(f"no phase produced a result (selected: {phases!r}; a "
+              "'# <name> phase FAILED' line above means the phase ran "
+              "and died; otherwise the selection matched nothing)",
               file=sys.stderr)
         sys.exit(2)
     headline_cold = headline is cold
