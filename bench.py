@@ -48,6 +48,7 @@ RSDL_BENCH_REDUCERS (override the reducer count).
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -334,7 +335,11 @@ def run_train(jax, filenames, *, num_epochs, batch_size, num_reducers,
         "batches": steps,
         "batch_size": batch_size,
         "microbatch": mb,
-        "final_loss": float(loss) if loss is not None else None,
+        # A non-finite loss means the model diverged; null the field (bare
+        # NaN is not valid JSON) and flag it so the failure stays loud.
+        "final_loss": (float(loss) if loss is not None
+                       and math.isfinite(float(loss)) else None),
+        "diverged": loss is not None and not math.isfinite(float(loss)),
         "timed_epochs": num_epochs,
         "duration_s": duration,
         "fill_s": fill_s if fill_s is not None else 0.0,
@@ -501,7 +506,9 @@ def main() -> None:
                 qname="bench-train"))
             if train is not None:
                 loss_txt = (f"{train['final_loss']:.4f}"
-                            if train["final_loss"] is not None else "n/a")
+                            if train["final_loss"] is not None
+                            else ("DIVERGED" if train.get("diverged")
+                                  else "n/a"))
                 print(f"# train: {train['rows_per_s']:,.0f} rows/s over "
                       f"{train['batches']} real DLRM micro-steps "
                       f"({train['microbatch']} rows, "
@@ -582,8 +589,12 @@ def main() -> None:
         "fill_s": round(headline.get("fill_s", 0.0), 3),
     }
     if cached is not None:
-        record["vs_baseline_cached"] = round(
-            cached["rows_per_s"] / baseline_rows_per_s, 3)
+        # Mirror the vs_baseline handling: a failed (fail-soft) baseline
+        # phase leaves baseline_rows_per_s None — omit the ratio, never
+        # destroy the already-measured phases with a TypeError.
+        record["vs_baseline_cached"] = (
+            round(cached["rows_per_s"] / baseline_rows_per_s, 3)
+            if baseline_rows_per_s is not None else None)
     if cold is not None and not headline_cold:
         record.update({
             "cold_rows_per_sec": round(cold["rows_per_s"], 1),
@@ -607,6 +618,7 @@ def main() -> None:
             "train_final_loss": (round(train["final_loss"], 5)
                                  if train["final_loss"] is not None
                                  else None),
+            "train_diverged": bool(train.get("diverged", False)),
             "train_model": f"dlrm-{train['model_size']}",
         })
 

@@ -311,17 +311,30 @@ class RemoteQueue:
                     raise RuntimeError(
                         f"remote queue {queue_index} already yielded its "
                         f"epoch-end sentinel")
-                fut = self._pending.pop(queue_index, None)
+                # At most ONE in-flight request per queue index: a second
+                # concurrent getter on the same index waits on the SAME
+                # future instead of issuing its own round trip, which
+                # could ingest batches out of request order. The future
+                # stays registered while in flight; whichever waiter
+                # observes it still registered after completion unlinks
+                # it and ingests — exactly once.
+                fut = self._pending.get(queue_index)
+                if fut is None:
+                    fut = self._pending[queue_index] = self._io.submit(
+                        self._fetch_batch, queue_index)
                 # Do the (possibly long) wire wait without holding the
                 # state lock, so a concurrent get on another queue index
                 # can still drain its local buffer.
                 self._state_lock.release()
                 try:
-                    items = (self._fetch_batch(queue_index)
-                             if fut is None else fut.result())
+                    items = fut.result()
                 finally:
                     self._state_lock.acquire()
-                self._ingest(queue_index, items)
+                    mine = self._pending.get(queue_index) is fut
+                    if mine:
+                        del self._pending[queue_index]
+                if mine:
+                    self._ingest(queue_index, items)
             item = buf.popleft()
         return item
 

@@ -105,6 +105,55 @@ def test_separate_process_trainer_rendezvous(tmp_parquet_dir):
     queue.shutdown()
 
 
+def test_concurrent_same_index_getters_preserve_fifo():
+    """Two threads blocked on the SAME queue index must share one
+    in-flight request: each consumer's observed sequence stays strictly
+    increasing (global per-index FIFO), never inverted by a second
+    racing round trip ingesting out of request order."""
+    queue = mq.MultiQueue(1, name=None)
+    n = 60
+    for i in range(n):
+        queue.put(0, pa.table({"seq": [i]}))
+    queue.put(0, None)  # one sentinel per consumer thread
+    queue.put(0, None)
+    got: dict = {0: [], 1: []}
+    errors: list = []
+    with svc.serve_queue(queue) as server:
+        # max_batch=1 keeps the client buffer empty after every pop, so
+        # both threads are constantly in the blocked-on-fetch path the
+        # fix serializes.
+        with svc.RemoteQueue(server.address, max_batch=1) as remote:
+
+            def consume(tid: int) -> None:
+                try:
+                    while True:
+                        item = remote.get(0)
+                        if item is None:
+                            return
+                        got[tid].append(item.column("seq")[0].as_py())
+                except RuntimeError as e:
+                    # Only the other thread draining the epoch sentinel is
+                    # benign; any other RuntimeError must fail the test.
+                    if "already yielded" not in str(e):
+                        errors.append(e)
+                except BaseException as e:  # noqa: BLE001 - surfaced below
+                    errors.append(e)
+
+            threads = [threading.Thread(target=consume, args=(t,),
+                                        daemon=True) for t in range(2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+                assert not t.is_alive(), "concurrent getter hung"
+    if errors:
+        raise errors[0]
+    for tid in (0, 1):
+        seq = got[tid]
+        assert seq == sorted(seq), f"thread {tid} saw inverted order: {seq}"
+    assert sorted(got[0] + got[1]) == list(range(n))
+
+
 def test_failed_ref_crosses_wire_as_failure_frame():
     """A queued ref whose task failed reaches the remote consumer as a
     KIND_FAILURE frame carrying the real cause, not a dead socket."""
