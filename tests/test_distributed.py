@@ -347,6 +347,140 @@ def test_distributed_resume_start_epoch(small_dataset):
             "resumed epoch 1 diverged from the original epoch 1")
 
 
+def _world_dataset_run(filenames, num_epochs, num_reducers, world, seed,
+                       batch_size, start_epoch=0, trainer0_consume=None):
+    """Dataset-level in-process world: every host consumes through the
+    real ShufflingDataset path. ``trainer0_consume(ds)`` runs on host 0
+    (global trainer 0) and its return value is returned; the other hosts
+    simply drain epochs ``[start_epoch, num_epochs)``."""
+    from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+
+    transports = tp.create_local_transports(world, recv_timeout_s=120.0)
+    out = {}
+    errors = []
+
+    def host_main(h):
+        try:
+            queue, res = dist.create_distributed_batch_queue_and_shuffle(
+                filenames, num_epochs, num_reducers, transports[h],
+                max_concurrent_epochs=2, seed=seed, num_workers=4,
+                start_epoch=start_epoch)
+            d = ShufflingDataset(
+                filenames, num_epochs, num_trainers=1,
+                batch_size=batch_size, rank=0, batch_queue=queue,
+                shuffle_result=res, seed=seed, start_epoch=start_epoch)
+            if h == 0 and trainer0_consume is not None:
+                out[0] = trainer0_consume(d)
+            else:
+                for epoch in range(start_epoch, num_epochs):
+                    d.set_epoch(epoch)
+                    for _ in d:
+                        pass
+        except BaseException as e:  # noqa: BLE001 - surfaced to the test
+            errors.append((h, e))
+
+    threads = [threading.Thread(target=host_main, args=(h,), daemon=True)
+               for h in range(world)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=180)
+        assert not t.is_alive(), "dataset-level world host hung"
+    for t in transports:
+        t.close()
+    if errors:
+        raise errors[0][1]
+    return out.get(0)
+
+
+def _consume_recording_checkpoint(ckpt_mod, seed, num_epochs, world,
+                                  batch_size, crash_point, path):
+    """Returns a trainer0_consume fn that iterates via resume_iterator
+    from a fresh checkpoint, saves the checkpoint when it reaches
+    ``crash_point`` = (epoch, batches_consumed), and records the full
+    per-batch key stream tagged with checkpoint positions."""
+
+    def consume(d):
+        c = ckpt_mod.LoaderCheckpoint(
+            seed=seed, epoch=0, batches_consumed=0, num_epochs=num_epochs,
+            num_trainers=world, rank=0, batch_size=batch_size)
+        stream = []
+        for batch in ckpt_mod.resume_iterator(d, c):
+            stream.append((c.epoch, c.batches_consumed,
+                           tuple(batch.column("key").to_pylist())))
+            if (c.epoch, c.batches_consumed) == crash_point:
+                c.save(path)
+        return stream
+
+    return consume
+
+
+def test_checkpoint_resume_world3_to_world1(small_dataset, tmp_path):
+    """The payoff of global-index PRNG keying (distributed.py docstring):
+    a LoaderCheckpoint saved MID-EPOCH under world=3 resumes under a
+    single-host (world=1) topology with a bit-identical remaining batch
+    stream — something the reference's unseeded shuffle can never do
+    (reference: shuffle.py:213,240)."""
+    from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+    from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+
+    filenames = small_dataset
+    num_epochs, num_reducers, world, seed, bs = 3, 6, 3, 31, 128
+    crash_point = (1, 3)  # "crash" after 3 batches of epoch 1
+    path = str(tmp_path / "ckpt.json")
+
+    full = _world_dataset_run(
+        filenames, num_epochs, num_reducers, world, seed, bs,
+        trainer0_consume=_consume_recording_checkpoint(
+            ckpt, seed, num_epochs, world, bs, crash_point, path))
+    expected = [keys for (e, i, keys) in full if (e, i) > crash_point]
+    assert expected, "crash point must leave a non-empty remainder"
+
+    loaded = ckpt.LoaderCheckpoint.load(path)
+    assert (loaded.epoch, loaded.batches_consumed) == crash_point
+    # world=1 resume: one host owns the whole shuffle; the same GLOBAL
+    # topology (num_trainers=3) keeps trainer 0's stream identity.
+    d = ShufflingDataset(
+        filenames, num_epochs, num_trainers=world, batch_size=bs, rank=0,
+        num_reducers=num_reducers, seed=seed, start_epoch=loaded.epoch,
+        queue_name="xtopo-w3-to-w1")
+    resumed = [tuple(b.column("key").to_pylist())
+               for b in ckpt.resume_iterator(d, loaded)]
+    assert resumed == expected, (
+        "world=1 resume diverged from the world=3 stream remainder")
+
+
+def test_checkpoint_resume_world1_to_world3(small_dataset, tmp_path):
+    """Reverse direction: checkpoint saved mid-epoch under a single-host
+    run resumes under world=3 bit-identically (scale-out after a crash)."""
+    from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+    from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+
+    filenames = small_dataset
+    num_epochs, num_reducers, world, seed, bs = 3, 6, 3, 47, 128
+    crash_point = (1, 4)
+    path = str(tmp_path / "ckpt.json")
+
+    d = ShufflingDataset(
+        filenames, num_epochs, num_trainers=world, batch_size=bs, rank=0,
+        num_reducers=num_reducers, seed=seed, queue_name="xtopo-w1-full")
+    consume = _consume_recording_checkpoint(
+        ckpt, seed, num_epochs, world, bs, crash_point, path)
+    full = consume(d)
+    expected = [keys for (e, i, keys) in full if (e, i) > crash_point]
+    assert expected
+
+    loaded = ckpt.LoaderCheckpoint.load(path)
+    resumed = _world_dataset_run(
+        filenames, num_epochs, num_reducers, world, seed, bs,
+        start_epoch=loaded.epoch,
+        trainer0_consume=lambda ds: [
+            tuple(b.column("key").to_pylist())
+            for b in ckpt.resume_iterator(ds, loaded)])
+    assert resumed == expected, (
+        "world=3 resume diverged from the world=1 stream remainder")
+
+
 def test_distributed_shuffle_applies_reduce_transform(tmp_path):
     """reduce_transform runs inside distributed reduce tasks too, exactly
     once per row per epoch across all hosts."""
