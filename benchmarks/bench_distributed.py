@@ -242,6 +242,64 @@ def bench_served_queue(filenames, num_epochs: int, num_reducers: int,
     return rows / duration
 
 
+def bench_served_queue_multi(filenames, num_epochs: int, num_reducers: int,
+                             ranks: int, max_batch: int = 8,
+                             prefetch: bool = True) -> float:
+    """Aggregate rows/s with ``ranks`` remote trainer ranks, each with its
+    OWN RemoteQueue TCP connection, draining its own per-rank stream of one
+    shuffle concurrently — the reference's multi-worker attach topology
+    over the wire (reference: multiqueue.py:127-154, one actor serving all
+    trainers). Each connection keeps one batched GET in flight, so ranks
+    pipeline their wire waits against each other."""
+    from ray_shuffling_data_loader_tpu import multiqueue_service as svc
+    from ray_shuffling_data_loader_tpu.dataset import (
+        ShufflingDataset, create_batch_queue_and_shuffle)
+    queue, shuffle_result = create_batch_queue_and_shuffle(
+        filenames, num_epochs=num_epochs, num_trainers=ranks,
+        batch_size=65_536, max_concurrent_epochs=2,
+        num_reducers=num_reducers, seed=0, queue_name=None, file_cache=None)
+    counts = [0] * ranks
+    errors: list = []
+    start = timeit.default_timer()
+    with svc.serve_queue(queue) as server:
+
+        def consume(rank: int) -> None:
+            try:
+                with svc.RemoteQueue(server.address, max_batch=max_batch,
+                                     prefetch=prefetch) as remote:
+                    ds = ShufflingDataset(
+                        filenames, num_epochs=num_epochs,
+                        num_trainers=ranks, batch_size=65_536, rank=rank,
+                        batch_queue=remote, shuffle_result=None,
+                        drop_last=False)
+                    for epoch in range(num_epochs):
+                        ds.set_epoch(epoch)
+                        for batch in ds:
+                            counts[rank] += batch.num_rows
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=consume, args=(r,), daemon=True)
+                   for r in range(ranks)]
+        for t in threads:
+            t.start()
+        # Poll-join: a dead rank's undrained stream back-pressures the
+        # producer and starves the others — shut the queue down (wakes
+        # blocked getters/putters with ShutdownError) instead of hanging.
+        while any(t.is_alive() for t in threads) and not errors:
+            for t in threads:
+                t.join(timeout=0.5)
+        if errors:
+            queue.shutdown()
+            for t in threads:
+                t.join(timeout=30)
+            raise errors[0]
+    duration = timeit.default_timer() - start
+    shuffle_result.result()
+    queue.shutdown()
+    return sum(counts) / duration
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--rows", type=int, default=200_000)
@@ -304,6 +362,13 @@ def main() -> None:
             max_batch=max_batch, prefetch=prefetch)
         print(f"served-queue {label}: {rows_per_s:,.0f} rows/s "
               f"({rows_per_s / inproc:.2f}x of in-process)")
+
+    for ranks in (2, 4):
+        rows_per_s = bench_served_queue_multi(
+            filenames, args.epochs, num_reducers=4, ranks=ranks)
+        print(f"served-queue remote ranks={ranks}: {rows_per_s:,.0f} "
+              f"rows/s aggregate ({rows_per_s / inproc:.2f}x of "
+              "in-process 1-trainer)")
 
     for world_size in (2, 4):
         rows_per_s = bench_process_world(

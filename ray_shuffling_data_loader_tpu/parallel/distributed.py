@@ -277,9 +277,8 @@ def shuffle_distributed(filenames: Sequence[str],
             num_reduces=len(plan.local_reducers(transport.host_id)),
             num_consumes=trainers_per_host)
         stats_collector.trial_start()
-    if file_cache == "auto":
-        file_cache = (sh.default_file_cache()
-                      if num_epochs - start_epoch > 1 else None)
+    file_cache, owns_file_cache = sh.resolve_file_cache(
+        file_cache, num_epochs - start_epoch)
 
     # Same budget semantics as the single-host driver, per host.
     from ray_shuffling_data_loader_tpu.spill import make_budget_state
@@ -328,6 +327,11 @@ def shuffle_distributed(filenames: Sequence[str],
     finally:
         if owns_pool:
             pool.shutdown()
+        if owns_file_cache:
+            # Same release point as the single-host driver: reducer
+            # outputs are gathered copies, so drained refs mean the
+            # decoded-cache scratch files have no remaining readers.
+            file_cache.close()
         if spill_manager is not None:
             spill_manager.report()
         if owns_pool:

@@ -178,6 +178,190 @@ def default_file_cache() -> Optional[FileTableCache]:
     return None
 
 
+class DiskTableCache:
+    """Decoded-table cache on local disk: Arrow IPC files, memory-mapped
+    back on hit.
+
+    The cold regime's dominant per-epoch cost is Parquet decompression +
+    decode, which the reference re-pays every epoch (reference:
+    shuffle.py:208) and the RAM cache can only skip while the decoded
+    corpus fits in memory. This tier removes the constraint: the FIRST
+    decode of a file writes the decoded table as an UNCOMPRESSED Arrow IPC
+    file to local scratch; every later epoch memory-maps it — no
+    decompression, no parse, zero-copy columns whose pages fault in lazily
+    and remain reclaimable page cache, so RSS stays bounded no matter how
+    large the corpus is. Measured on the bench host: parquet decode
+    ~184 ns/row vs mmap open ~0; the one-time IPC write costs ~132 ns/row.
+
+    Disk usage is budgeted (``max_bytes``); once full, further files
+    simply re-decode parquet each epoch (same as no cache). Any IO error
+    degrades the same way. ``bytes_cached`` reports 0 — the budget
+    machinery (spill.make_budget_state) uses it to discount RESIDENT cache
+    growth from the transient-bytes ledger, and this cache pins no RAM.
+    """
+
+    def __init__(self, max_bytes: int, cache_dir: Optional[str] = None):
+        import tempfile as _tempfile
+        self.max_bytes = max_bytes
+        if cache_dir is None:
+            cache_dir = _tempfile.mkdtemp(prefix="rsdl_decoded_cache_")
+            self._owns_dir = True
+        else:
+            _os.makedirs(cache_dir, exist_ok=True)
+            self._owns_dir = False
+        self.cache_dir = cache_dir
+        self._bytes = 0
+        self._paths: Dict[str, Tuple[str, int]] = {}  # key -> (path, bytes)
+        self._inflight: set = set()  # keys with a write in progress
+        self._lock = threading.Lock()
+        self._closed = False
+
+    def _path_for(self, key: str) -> str:
+        import hashlib
+        digest = hashlib.sha1(key.encode()).hexdigest()[:16]
+        return _os.path.join(self.cache_dir, f"{digest}.arrow")
+
+    def _forget(self, key: str, path: str, nbytes: int) -> None:
+        """Drop a bad/stale entry: uncharge the budget, delete the file."""
+        with self._lock:
+            if self._paths.get(key, (None, 0))[0] == path:
+                del self._paths[key]
+                self._bytes -= nbytes
+        try:
+            _os.remove(path)
+        except OSError:
+            pass
+
+    def get(self, key: str) -> Optional[pa.Table]:
+        with self._lock:
+            entry = self._paths.get(key)
+        if entry is None:
+            return None
+        path, nbytes = entry
+        try:
+            with pa.memory_map(path) as source:
+                return pa.ipc.open_file(source).read_all()
+        except (OSError, pa.ArrowInvalid) as e:
+            logger.warning("decoded-cache read failed for %s (%s); "
+                           "re-decoding", key, e)
+            self._forget(key, path, nbytes)
+            return None
+
+    def put(self, key: str, table: pa.Table) -> bool:
+        """Write-if-budget-allows; returns True if the file was cached."""
+        nbytes = table.nbytes
+        with self._lock:
+            if self._closed:
+                return False
+            if key in self._paths:
+                return True
+            if key in self._inflight:
+                # Another epoch's map task is writing this key right now
+                # (concurrent epochs map the same files); it keeps its own
+                # decoded table for this epoch, the writer's file serves
+                # the next.
+                return False
+            if self._bytes + nbytes > self.max_bytes:
+                return False
+            # Reserve under the lock so concurrent map tasks cannot
+            # overshoot the budget together; release on failure below.
+            self._bytes += nbytes
+            self._inflight.add(key)
+        path = self._path_for(key)
+        # Writer-unique tmp name: _inflight already serializes same-key
+        # writers, this guards against a stale .tmp from a crashed run.
+        tmp_path = f"{path}.{id(table):x}.tmp"
+        try:
+            with pa.OSFile(tmp_path, "wb") as sink:
+                with pa.ipc.new_file(sink, table.schema) as writer:
+                    writer.write_table(table)
+            _os.replace(tmp_path, path)
+        except OSError as e:
+            logger.warning("decoded-cache write failed for %s (%s); "
+                           "cold reads continue from parquet", key, e)
+            with self._lock:
+                self._bytes -= nbytes
+                self._inflight.discard(key)
+            try:
+                _os.remove(tmp_path)
+            except OSError:
+                pass
+            return False
+        with self._lock:
+            self._inflight.discard(key)
+            if self._closed:  # closed while writing: drop the orphan
+                self._bytes -= nbytes
+                try:
+                    _os.remove(path)
+                except OSError:
+                    pass
+                return False
+            self._paths[key] = (path, nbytes)
+        return True
+
+    @property
+    def bytes_cached(self) -> int:
+        return 0  # pins no RAM (see class docstring)
+
+    @property
+    def disk_bytes(self) -> int:
+        with self._lock:
+            return self._bytes
+
+    def close(self) -> None:
+        """Delete cached files (safe even with live mmaps: POSIX keeps
+        unlinked mappings valid) and, if this cache made its own scratch
+        dir, the dir itself."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            paths = [p for p, _ in self._paths.values()]
+            self._paths.clear()
+            self._bytes = 0
+        for path in paths:
+            try:
+                _os.remove(path)
+            except OSError:
+                pass
+        if self._owns_dir:
+            try:
+                _os.rmdir(self.cache_dir)
+            except OSError:
+                pass
+
+
+def default_disk_cache_bytes(cache_dir: Optional[str] = None) -> int:
+    """Disk budget for ``file_cache="disk"``: half the free space of the
+    scratch filesystem (decoded tables are ~2-3x their parquet size)."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+    try:
+        free = _shutil.disk_usage(cache_dir or _tempfile.gettempdir()).free
+        return free // 2
+    except OSError:
+        return 16 << 30
+
+
+def resolve_file_cache(spec, epochs_remaining: int):
+    """Resolve a ``file_cache`` argument to ``(cache, owned)``.
+
+    ``spec`` is ``"auto"`` (RAM cache when >1 epoch will map each file),
+    ``"disk"`` (fresh :class:`DiskTableCache`, budgeted by
+    ``default_disk_cache_bytes``), ``None``, or an instance. ``owned`` is
+    True when this call created a DiskTableCache the driver must close
+    after the run (its scratch files are useless to anyone else: reducer
+    outputs are gathered copies, never views of cached tables)."""
+    if spec == "auto":
+        return (default_file_cache() if epochs_remaining > 1 else None,
+                False)
+    if spec == "disk":
+        if epochs_remaining <= 1:
+            return None, False
+        return DiskTableCache(max_bytes=default_disk_cache_bytes()), True
+    return spec, False
+
+
 class MapShard:
     """Lazy map output: the source table plus per-reducer row-index arrays.
 
@@ -571,10 +755,9 @@ def shuffle(filenames: Sequence[str],
         stats_collector.trial_start()
     start = timeit.default_timer()
 
-    if file_cache == "auto":
-        # Caching only pays when a file is mapped more than once.
-        file_cache = (default_file_cache()
-                      if num_epochs - start_epoch > 1 else None)
+    # Caching only pays when a file is mapped more than once.
+    file_cache, owns_file_cache = resolve_file_cache(
+        file_cache, num_epochs - start_epoch)
     owns_pool = pool is None
     if pool is None:
         pool = ex.Executor(num_workers=num_workers,
@@ -649,6 +832,11 @@ def shuffle(filenames: Sequence[str],
     finally:
         if owns_pool:
             pool.shutdown()
+        if owns_file_cache:
+            # Reducer outputs are gathered COPIES, never views of cached
+            # tables, and all refs were drained above — the scratch files
+            # have no remaining readers.
+            file_cache.close()
         if spill_manager is not None:
             # Scratch-dir deletion is reference-managed (consumers may
             # still be draining spilled batches from the queue).

@@ -154,6 +154,123 @@ def test_cached_epochs_replay_identically(tmp_path):
         assert with_cache[key].equals(without[key])
 
 
+def test_disk_table_cache_roundtrip_budget_and_close(tmp_path):
+    filenames = write_numeric_files(tmp_path, num_files=2)
+    cache = sh.DiskTableCache(max_bytes=1 << 30,
+                              cache_dir=str(tmp_path / "dcache"))
+    assert cache.get(filenames[0]) is None
+    table = sh.fileio.read_parquet(filenames[0]).combine_chunks()
+    assert cache.put(filenames[0], table)
+    assert cache.disk_bytes > 0
+    assert cache.bytes_cached == 0  # pins no RAM by contract
+    hit = cache.get(filenames[0])
+    assert hit is not None and hit.equals(table)
+    # Zero budget: refuses to store, reports miss, keeps working.
+    tiny = sh.DiskTableCache(max_bytes=0,
+                             cache_dir=str(tmp_path / "tiny"))
+    assert not tiny.put(filenames[1], table)
+    assert tiny.get(filenames[1]) is None
+    # close() deletes the scratch files; later put/get degrade to misses.
+    cache.close()
+    assert not any(p.suffix == ".arrow"
+                   for p in (tmp_path / "dcache").iterdir())
+    assert cache.get(filenames[0]) is None
+    assert not cache.put(filenames[0], table)
+
+
+def test_disk_cache_corrupt_file_degrades_to_redecode(tmp_path):
+    filenames = write_numeric_files(tmp_path, num_files=1)
+    cache = sh.DiskTableCache(max_bytes=1 << 30,
+                              cache_dir=str(tmp_path / "dcache"))
+    table = sh.fileio.read_parquet(filenames[0]).combine_chunks()
+    assert cache.put(filenames[0], table)
+    # Truncate the IPC file behind the cache's back.
+    (path,) = [p for p in (tmp_path / "dcache").iterdir()
+               if p.suffix == ".arrow"]
+    path.write_bytes(b"not an arrow file")
+    assert cache.get(filenames[0]) is None  # logged miss, not a crash
+    # The shuffle_map path then re-decodes parquet transparently.
+    shard = sh.shuffle_map(filenames[0], 2, 0, 0, 0, file_cache=cache)
+    assert shard.table.num_rows == table.num_rows
+
+
+def test_disk_cached_epochs_replay_identically(tmp_path):
+    """Epochs served from the mmap'd decoded cache are bit-identical to
+    re-decoded epochs (same guarantee the RAM cache test pins)."""
+    filenames = write_numeric_files(tmp_path)
+
+    def run(file_cache):
+        outs = {}
+        for epoch in range(2):
+            shards = [
+                sh.shuffle_map(f, 2, seed=5, epoch=epoch, file_index=i,
+                               file_cache=file_cache)
+                for i, f in enumerate(filenames)
+            ]
+            for r in range(2):
+                outs[(epoch, r)] = sh.shuffle_reduce(
+                    r, seed=5, epoch=epoch, chunks=[s[r] for s in shards])
+        return outs
+
+    cache = sh.DiskTableCache(max_bytes=1 << 30,
+                              cache_dir=str(tmp_path / "dcache"))
+    try:
+        with_cache = run(cache)
+        assert cache.disk_bytes > 0  # the tier actually engaged
+    finally:
+        cache.close()
+    without = run(None)
+    for key in without:
+        assert with_cache[key].equals(without[key])
+
+
+def test_shuffle_disk_mode_end_to_end(tmp_path):
+    """file_cache="disk" through the full driver: same batch stream as no
+    cache, and the run-owned scratch dir is gone afterwards."""
+    import glob
+    import os
+    import tempfile
+
+    filenames = write_numeric_files(tmp_path, num_files=3)
+
+    def run(file_cache):
+        collected = {}
+
+        def consumer(trainer, epoch, refs):
+            if refs is not None:
+                collected.setdefault(epoch, []).extend(refs)
+
+        sh.shuffle(filenames, consumer, num_epochs=2, num_reducers=2,
+                   num_trainers=1, seed=9, collect_stats=False,
+                   file_cache=file_cache)
+        return {
+            epoch: [ref.result().column("key").to_pylist() for ref in refs]
+            for epoch, refs in collected.items()
+        }
+
+    before = set(glob.glob(
+        os.path.join(tempfile.gettempdir(), "rsdl_decoded_cache_*")))
+    disk = run("disk")
+    after = set(glob.glob(
+        os.path.join(tempfile.gettempdir(), "rsdl_decoded_cache_*")))
+    assert after == before, "disk-cache scratch dir leaked"
+    assert run(None) == disk
+
+
+def test_resolve_file_cache_modes():
+    ram, owned = sh.resolve_file_cache("auto", epochs_remaining=4)
+    assert not owned
+    disk, owned = sh.resolve_file_cache("disk", epochs_remaining=4)
+    assert isinstance(disk, sh.DiskTableCache) and owned
+    disk.close()
+    # A single remaining epoch maps each file once: no cache pays.
+    assert sh.resolve_file_cache("disk", epochs_remaining=1) == (None, False)
+    assert sh.resolve_file_cache("auto", epochs_remaining=1) == (None, False)
+    assert sh.resolve_file_cache(None, epochs_remaining=4) == (None, False)
+    inst = sh.FileTableCache(max_bytes=1)
+    assert sh.resolve_file_cache(inst, epochs_remaining=4) == (inst, False)
+
+
 def test_cast_transform_casts_spec_columns(tmp_path):
     filenames = write_numeric_files(tmp_path, num_files=1)
     transform = jd.make_cast_transform(
