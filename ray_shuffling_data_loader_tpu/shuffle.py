@@ -556,6 +556,26 @@ def shuffle_reduce(reduce_index: int,
     return shuffled
 
 
+def _promote_large_offsets(table: pa.Table) -> pa.Table:
+    """Cast 32-bit-offset variable-width columns (binary/string/list) to
+    their 64-bit ``large_*`` forms so a single reducer output may exceed
+    2 GiB of variable-width data."""
+    fields = []
+    changed = False
+    for field in table.schema:
+        t = field.type
+        if pa.types.is_binary(t):
+            t, changed = pa.large_binary(), True
+        elif pa.types.is_string(t):
+            t, changed = pa.large_string(), True
+        elif pa.types.is_list(t):
+            t, changed = pa.large_list(t.value_type), True
+        fields.append(field.with_type(t))
+    if not changed:
+        return table
+    return table.cast(pa.schema(fields, metadata=table.schema.metadata))
+
+
 def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
                          reduce_transform, gather_threads=None):
     shuffled = None
@@ -590,7 +610,16 @@ def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
         table = pa.concat_tables(tables)
         perm = ops.permutation(table.num_rows,
                                ops.reduce_rng(seed, epoch, reduce_index))
-        shuffled = table.take(perm)
+        try:
+            shuffled = table.take(perm)
+        except pa.ArrowInvalid:
+            # >2 GiB of variable-width data in ONE reducer output (e.g.
+            # 1e6-image corpora with few reducers): the gather's chunk
+            # concatenation overflows 32-bit offsets. Promote to 64-bit
+            # offset types and retry — Arrow IPC, the transport, and the
+            # consumers all handle large_* columns.
+            table = _promote_large_offsets(table)
+            shuffled = table.take(perm)
     elif shuffled is None:
         shuffled = pa.table({})
     # Applied even to 0-row outputs: a schema-changing transform (e.g.

@@ -154,6 +154,33 @@ def test_cached_epochs_replay_identically(tmp_path):
         assert with_cache[key].equals(without[key])
 
 
+def test_promote_large_offsets_preserves_content():
+    """The >2GiB-reducer-output fallback: 32-bit-offset variable-width
+    columns promote to large_* types with identical values (the gather
+    then uses 64-bit offsets; regression for the 1e6-image ImageNet run
+    that overflowed binary offsets in table.take)."""
+    table = pa.table({
+        "b": pa.array([b"x" * 10, b"", b"yz"], type=pa.binary()),
+        "s": pa.array(["a", "bb", ""], type=pa.string()),
+        "l": pa.array([[1, 2], [], [3]], type=pa.list_(pa.int64())),
+        "i": pa.array([1, 2, 3], type=pa.int32()),  # untouched
+    })
+    out = sh._promote_large_offsets(table)
+    assert out.schema.field("b").type == pa.large_binary()
+    assert out.schema.field("s").type == pa.large_string()
+    assert out.schema.field("l").type == pa.large_list(pa.int64())
+    assert out.schema.field("i").type == pa.int32()
+    for name in table.column_names:
+        assert out.column(name).to_pylist() == \
+            table.column(name).to_pylist()
+    # take on the promoted table matches take on the original.
+    perm = [2, 0, 1]
+    assert out.take(perm).to_pylist() == table.take(perm).to_pylist()
+    # No variable-width columns: the table is returned unchanged.
+    plain = pa.table({"i": pa.array([1, 2], type=pa.int64())})
+    assert sh._promote_large_offsets(plain) is plain
+
+
 def test_disk_table_cache_roundtrip_budget_and_close(tmp_path):
     filenames = write_numeric_files(tmp_path, num_files=2)
     cache = sh.DiskTableCache(max_bytes=1 << 30,
