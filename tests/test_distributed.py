@@ -216,6 +216,33 @@ def test_distributed_trainers_per_host(small_dataset):
     assert sorted(union) == list(range(6000))
 
 
+def test_distributed_world4_tph2_multiepoch_bit_exact(small_dataset):
+    """The dryrun's widest topology, pinned in the suite too: world=4
+    with trainers_per_host=2 (8 global trainers), 2 epochs, 10 reducers
+    split unevenly over the 8 trainers — every stream bit-identical to
+    the single-host num_trainers=8 shuffle."""
+    filenames = small_dataset
+    num_epochs, num_reducers, world, tph, seed = 2, 10, 4, 2, 41
+    distributed = _run_world(filenames, num_epochs, num_reducers, world,
+                             seed=seed, trainers_per_host=tph)
+
+    collected = {}
+
+    def consumer(trainer, epoch, refs):
+        if refs is not None:
+            collected.setdefault((trainer, epoch), []).extend(refs)
+
+    run_shuffle(filenames, consumer, num_epochs, num_reducers,
+                num_trainers=world * tph, max_concurrent_epochs=2,
+                seed=seed, collect_stats=False)
+    for (trainer, epoch), refs in collected.items():
+        keys = []
+        for ref in refs:
+            keys.extend(ref.result().column("key").to_pylist())
+        assert distributed[trainer][epoch] == keys, (
+            f"trainer {trainer} epoch {epoch}: world=4x2 stream diverged")
+
+
 def test_distributed_single_host_degenerate(small_dataset):
     """world=1: no peers, everything local, still correct."""
     results = _run_world(small_dataset, 1, 4, 1, seed=2)
