@@ -149,6 +149,10 @@ def _make_dataset(filenames, *, num_epochs, batch_size, num_reducers,
                   spill_dir=None):
     from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
     from ray_shuffling_data_loader_tpu.workloads.dlrm_criteo import dlrm_spec
+    # Per-chunk transfer cap for the bulk device-rebatch path
+    # (RSDL_BENCH_DEVICE_TABLE_BYTES): smaller chunks bound the tunnel's
+    # in-flight transfer backlog on tunneled devices.
+    table_bytes = os.environ.get("RSDL_BENCH_DEVICE_TABLE_BYTES")
     return JaxShufflingDataset(
         filenames, num_epochs=num_epochs, num_trainers=num_trainers,
         batch_size=batch_size, rank=rank,
@@ -157,6 +161,7 @@ def _make_dataset(filenames, *, num_epochs, batch_size, num_reducers,
         prefetch_size=prefetch_size,
         file_cache=_cold_cache_mode() if cold else "auto",
         max_inflight_bytes=max_inflight_bytes, spill_dir=spill_dir,
+        max_device_table_bytes=int(table_bytes) if table_bytes else None,
         device_rebatch=device_rebatch, **dlrm_spec())
 
 
