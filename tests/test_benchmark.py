@@ -183,3 +183,61 @@ def test_run_ingest_phase_dict_contract(tmp_path):
         if cold:
             # Cold clocks from launch: the window contains the fill.
             assert r["duration_s"] >= r["fill_s"]
+
+
+def test_scanned_chunk_stepper_matches_sequential_micro_steps():
+    """The train phase's one-jit-call-per-chunk lax.scan stepper must be
+    bit-equivalent (up to float tolerance) to dispatching each micro-step
+    from Python — same slices, same Adam updates, same final loss."""
+    import importlib.util
+
+    import numpy as np
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod2", os.path.join(repo, "bench.py"))
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+
+    from ray_shuffling_data_loader_tpu.models import dlrm
+
+    cfg = dlrm.DLRMConfig(vocab_sizes=(13, 7, 20), embed_dim=4,
+                          top_hidden=(16, 8), compute_dtype=jnp.float32)
+    opt = optax.adam(1e-3)
+    mb, steps_per_chunk = 4, 3
+    chunk = mb * steps_per_chunk
+    rng = np.random.default_rng(0)
+    cols = [jnp.asarray(rng.integers(0, v, chunk).astype(np.int32))
+            for v in cfg.vocab_sizes]
+    labels = jnp.asarray(rng.random((chunk, 1)).astype(np.float32))
+
+    params = dlrm.init(cfg, jax.random.key(0))
+    opt_state = opt.init(params)
+    stepper = bench_mod._make_chunk_stepper(jax, dlrm, cfg, opt, mb,
+                                            steps_per_chunk)
+    s_params, s_opt, s_loss = stepper(params, opt_state, cols, labels)
+
+    # Reference: the same math dispatched one micro-step at a time.
+    params = dlrm.init(cfg, jax.random.key(0))
+    opt_state = opt.init(params)
+    loss = None
+    for i in range(steps_per_chunk):
+        mcols = [lax.dynamic_slice_in_dim(c, i * mb, mb, axis=0)
+                 for c in cols]
+        mlab = lax.dynamic_slice_in_dim(labels, i * mb, mb, axis=0)
+        loss, grads = jax.value_and_grad(
+            lambda p: dlrm.loss_fn(cfg, p, None, mcols, mlab))(params)
+        updates, opt_state = opt.update(grads, opt_state)
+        params = optax.apply_updates(params, updates)
+
+    np.testing.assert_allclose(float(s_loss), float(loss), rtol=1e-5)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(a),
+                                                np.asarray(b), rtol=1e-5,
+                                                atol=1e-6),
+        s_params, params)
