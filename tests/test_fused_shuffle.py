@@ -205,6 +205,37 @@ def test_disk_table_cache_roundtrip_budget_and_close(tmp_path):
     assert not cache.put(filenames[0], table)
 
 
+def test_disk_cache_concurrent_same_key_puts_single_writer(tmp_path):
+    """Concurrent epochs map the same file: only one writer wins the key,
+    the losers return False immediately (no budget double-charge, no
+    torn file), and the winner's file reads back intact."""
+    import threading
+
+    filenames = write_numeric_files(tmp_path, num_files=1)
+    table = sh.fileio.read_parquet(filenames[0]).combine_chunks()
+    cache = sh.DiskTableCache(max_bytes=1 << 30,
+                              cache_dir=str(tmp_path / "dcache"))
+    results = []
+    barrier = threading.Barrier(4)
+
+    def put():
+        barrier.wait()
+        results.append(cache.put(filenames[0], table))
+
+    threads = [threading.Thread(target=put) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=30)
+        assert not t.is_alive()
+    assert results.count(True) >= 1
+    # Budget charged exactly once regardless of how many writers raced.
+    assert cache.disk_bytes == table.nbytes
+    hit = cache.get(filenames[0])
+    assert hit is not None and hit.equals(table)
+    cache.close()
+
+
 def test_disk_cache_corrupt_file_degrades_to_redecode(tmp_path):
     filenames = write_numeric_files(tmp_path, num_files=1)
     cache = sh.DiskTableCache(max_bytes=1 << 30,
