@@ -157,6 +157,12 @@ def parse_args(argv=None):
                         help="disable the cross-epoch file-table cache: "
                              "every epoch re-reads + re-decodes Parquet "
                              "(the reference's corpus->RAM regime)")
+    parser.add_argument(
+        "--file-cache", choices=["auto", "none", "disk"], default=None,
+        help="decoded-table cache tier: auto (RAM, default), none "
+             "(re-decode every epoch; same as --cold), or disk (decode "
+             "once, stream later epochs from mmap'd Arrow IPC scratch — "
+             "the corpus-exceeds-RAM answer). Overrides --cold when given.")
     parser.add_argument("--max-inflight-bytes", type=int, default=None,
                         help="transient pipeline byte budget; the driver "
                              "throttles epoch launches against it")
@@ -245,7 +251,10 @@ def main(argv=None) -> None:
         num_trials=args.num_trials, trials_timeout=args.trials_timeout,
         seed=args.seed, map_transform=map_transform,
         reduce_transform=reduce_transform,
-        file_cache=None if args.cold else "auto",
+        file_cache=({"auto": "auto", "none": None,
+                     "disk": "disk"}[args.file_cache]
+                    if args.file_cache is not None
+                    else (None if args.cold else "auto")),
         max_inflight_bytes=args.max_inflight_bytes,
         spill_dir=args.spill_dir)
 

@@ -241,3 +241,24 @@ def test_scanned_chunk_stepper_matches_sequential_micro_steps():
                                                 np.asarray(b), rtol=1e-5,
                                                 atol=1e-6),
         s_params, params)
+
+
+def test_file_cache_flag_choices():
+    args = benchmark.parse_args(["--file-cache", "disk"])
+    assert args.file_cache == "disk"
+    args = benchmark.parse_args(["--cold"])
+    assert args.file_cache is None and args.cold
+    with pytest.raises(SystemExit):
+        benchmark.parse_args(["--file-cache", "bogus"])
+
+
+def test_end_to_end_disk_cache(tmp_path):
+    """--file-cache disk through the full harness CLI: the run completes
+    and later epochs stream from the decoded-IPC tier."""
+    benchmark.main([
+        "--num-rows", "2000", "--num-files", "2",
+        "--num-row-groups-per-file", "1", "--num-reducers", "2",
+        "--num-trainers", "1", "--num-epochs", "3", "--batch-size", "500",
+        "--num-trials", "1", "--file-cache", "disk",
+        "--data-dir", str(tmp_path / "data"),
+        "--stats-dir", str(tmp_path / "results"), "--no-stats"])
