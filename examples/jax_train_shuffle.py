@@ -58,6 +58,12 @@ def parse_args(argv=None):
     p.add_argument("--use-old-data", action="store_true")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--learning-rate", type=float, default=1e-3)
+    p.add_argument("--file-cache", choices=["auto", "none", "disk"],
+                   default="auto",
+                   help="decoded-table cache tier: auto (RAM), none "
+                        "(re-decode every epoch), disk (decode once, "
+                        "stream later epochs from mmap'd Arrow IPC — the "
+                        "corpus-exceeds-RAM regime)")
     p.add_argument("--max-inflight-bytes", type=int, default=None,
                    help="transient pipeline memory budget (bytes); see "
                         "examples/memory_budget.md")
@@ -153,7 +159,9 @@ def main(argv=None):
         max_concurrent_epochs=args.max_concurrent_epochs, seed=args.seed,
         drop_last=True, queue_name=f"example-queue-{rank}",
         max_inflight_bytes=args.max_inflight_bytes,
-        spill_dir=args.spill_dir)
+        spill_dir=args.spill_dir,
+        file_cache={"auto": "auto", "none": None,
+                    "disk": "disk"}[args.file_cache])
     transport = None
     if multi_host and os.environ.get("RSDL_HOSTS"):
         # GLOBAL shuffle: rows from any host's files can reach any trainer
@@ -182,7 +190,8 @@ def main(argv=None):
                 max_concurrent_epochs=args.max_concurrent_epochs,
                 seed=args.seed, queue_name=dataset_kwargs["queue_name"],
                 max_inflight_bytes=args.max_inflight_bytes,
-                spill_dir=args.spill_dir))
+                spill_dir=args.spill_dir,
+                file_cache=dataset_kwargs["file_cache"]))
         ds = JaxShufflingDataset(
             sorted_files, batch_queue=batch_queue,
             shuffle_result=shuffle_result,
