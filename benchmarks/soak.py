@@ -128,6 +128,41 @@ def scenario_seed_sweep(files, seeds: int) -> None:
           "seed-0 replay bit-identical")
 
 
+def scenario_disk_cache_churn(files, cycles: int) -> None:
+    """Many successive runs with the decoded-IPC disk tier: every run's
+    scratch dir is removed at drain (no /tmp leak) and the epochs stay
+    complete and bit-identical to the RAM-cache order."""
+    import glob
+
+    pattern = os.path.join(tempfile.gettempdir(), "rsdl_decoded_cache_*")
+    before_dirs = set(glob.glob(pattern))
+
+    def run(cache, qname):
+        ds = JaxShufflingDataset(
+            files, num_epochs=2, num_trainers=1, batch_size=2048, rank=0,
+            feature_columns=["key"], feature_types=[np.int64],
+            label_column="labels", num_reducers=3, seed=7,
+            drop_last=False, file_cache=cache, queue_name=qname)
+        out = []
+        for epoch in range(2):
+            ds.set_epoch(epoch)
+            out.append(np.concatenate(
+                [np.asarray(f[0]).ravel() for f, _ in ds]))
+        ds.close()
+        return out
+
+    ram = run("auto", "soak-disk-ref")
+    ok = True
+    for i in range(cycles):
+        disk = run("disk", f"soak-disk-{i}")
+        ok = ok and all(np.array_equal(a, b) for a, b in zip(ram, disk))
+    gc.collect()
+    leaked = set(glob.glob(pattern)) - before_dirs
+    check("disk_cache_churn", ok and not leaked,
+          f"{cycles} disk-tier runs: streams bit-identical to RAM cache, "
+          f"{len(leaked)} scratch dirs leaked")
+
+
 def main() -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--quick", action="store_true")
@@ -141,6 +176,7 @@ def main() -> None:
         scenario_lifecycle_churn(files, cycles)
         scenario_long_budget_run(files, epochs)
         scenario_seed_sweep(files, seeds)
+        scenario_disk_cache_churn(files, max(3, cycles // 3))
 
     if FAILURES:
         print(f"SOAK FAILED: {FAILURES}")
