@@ -262,3 +262,36 @@ def test_end_to_end_disk_cache(tmp_path):
         "--num-trials", "1", "--file-cache", "disk",
         "--data-dir", str(tmp_path / "data"),
         "--stats-dir", str(tmp_path / "results"), "--no-stats"])
+
+
+def test_run_ingest_multi_contract(tmp_path):
+    """Multi-trainer ingest: aggregate rows cover every rank's stream,
+    the launch clock is recorded, and the result dict carries everything
+    main() publishes."""
+    import importlib.util
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod3", os.path.join(repo, "bench.py"))
+    bench_mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench_mod)
+
+    import jax
+
+    from ray_shuffling_data_loader_tpu import data_generation as dg
+
+    filenames, _ = dg.generate_data_local(8000, 2, 1, 0.0, str(tmp_path))
+    r = bench_mod.run_ingest_multi(
+        jax, filenames, num_epochs=2, batch_size=500, num_reducers=2,
+        prefetch_size=2, cold=False, device_rebatch=False, step_ms=0,
+        qname="ingest-multi-contract", num_trainers=2)
+    for key in ("rows_per_s", "stall_s", "stall_pct", "wait_mean_ms",
+                "batches", "timed_epochs", "duration_s", "fill_s",
+                "num_trainers", "clock"):
+        assert key in r, key
+    assert r["num_trainers"] == 2
+    assert r["clock"] == "launch"
+    assert r["rows_per_s"] > 0
+    # drop_last=True per rank: both ranks' full batches are consumed;
+    # 8000 rows over 2 ranks x 2 epochs ~ 16000 minus per-rank remainders.
+    assert r["rows_per_s"] * r["duration_s"] >= 14000
