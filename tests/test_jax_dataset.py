@@ -612,3 +612,30 @@ def test_device_rebatch_auto_falls_back_on_repacking_spec(tmp_path):
         for x, y in zip(fa, fb):
             np.testing.assert_array_equal(x, y)
         np.testing.assert_array_equal(la, lb)
+
+
+def test_disk_cache_mode_matches_ram_cache_stream(tmp_path):
+    """file_cache="disk" at the JAX-binding level: later epochs stream
+    from the mmap'd decoded-IPC tier and the device batch stream is
+    bit-identical to the RAM-cache run."""
+    filenames = write_files(tmp_path, num_files=2, rows_per_file=96)
+
+    def run(cache, qname):
+        ds = jd.JaxShufflingDataset(
+            filenames, num_epochs=2, num_trainers=1, batch_size=32,
+            rank=0, feature_columns=["emb_1", "emb_2"],
+            feature_types=[np.int64, np.int64], label_column="labels",
+            num_reducers=2, seed=5, drop_last=True, file_cache=cache,
+            queue_name=qname)
+        out = []
+        for epoch in range(2):
+            ds.set_epoch(epoch)
+            for feats, lb in ds:
+                out.append((tuple(np.asarray(f).tolist() for f in feats),
+                            np.asarray(lb).tolist()))
+        ds.close()
+        return out
+
+    ram = run("auto", "jaxdisk-ram")
+    disk = run("disk", "jaxdisk-disk")
+    assert ram == disk and len(ram) == 12  # 2 epochs x 192 rows / 32
