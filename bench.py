@@ -118,6 +118,9 @@ def _pandas_reference_baseline(filenames, num_reducers: int,
     for filename in filenames:
         rows = pd.read_parquet(filename)
         total_rows += len(rows)
+        # Deliberately the reference's own unseeded draw (its map stage,
+        # reference: shuffle.py:213) — this is the baseline being timed,
+        # not pipeline code: rsdl-lint: disable=unseeded-random
         assignment = np.random.randint(num_reducers, size=len(rows))
         for r in range(num_reducers):
             reducer_parts[r].append(rows[assignment == r])
@@ -169,7 +172,8 @@ def _make_dataset(filenames, *, num_epochs, batch_size, num_reducers,
 
 
 def run_ingest(jax, filenames, *, num_epochs, batch_size, num_reducers,
-               prefetch_size, cold, device_rebatch, step_ms, qname) -> dict:
+               prefetch_size, cold, device_rebatch, step_ms, qname,
+               max_inflight_bytes=None, spill_dir=None) -> dict:
     """Timed ingest: shuffle -> batches -> device, near-zero consumer.
 
     Timing protocol (round 4 fix): a separate ONE-epoch warm-up dataset
@@ -214,7 +218,9 @@ def run_ingest(jax, filenames, *, num_epochs, batch_size, num_reducers,
                          num_reducers=num_reducers,
                          prefetch_size=prefetch_size, cold=cold,
                          device_rebatch=device_rebatch,
-                         qname=f"{qname}-warm")
+                         qname=f"{qname}-warm",
+                         max_inflight_bytes=max_inflight_bytes,
+                         spill_dir=spill_dir)
     try:
         warm.set_epoch(0)
         last = None
@@ -228,7 +234,9 @@ def run_ingest(jax, filenames, *, num_epochs, batch_size, num_reducers,
     ds = _make_dataset(filenames, num_epochs=num_epochs,
                        batch_size=batch_size, num_reducers=num_reducers,
                        prefetch_size=prefetch_size, cold=cold,
-                       device_rebatch=device_rebatch, qname=qname)
+                       device_rebatch=device_rebatch, qname=qname,
+                       max_inflight_bytes=max_inflight_bytes,
+                       spill_dir=spill_dir)
     rows_consumed = 0
     start = launch if cold else None  # cold: launch-to-last-batch
     fill_s = None
@@ -298,7 +306,9 @@ def run_ingest_multi(jax, filenames, *, num_epochs, batch_size,
                          num_reducers=num_reducers,
                          prefetch_size=prefetch_size, cold=cold,
                          device_rebatch=device_rebatch,
-                         qname=f"{qname}-warm")
+                         qname=f"{qname}-warm",
+                         max_inflight_bytes=max_inflight_bytes,
+                         spill_dir=spill_dir)
     try:
         warm.set_epoch(0)
         last = None
@@ -365,7 +375,10 @@ def run_ingest_multi(jax, filenames, *, num_epochs, batch_size,
         for ds in datasets:
             try:
                 ds.close()
-            except Exception:  # noqa: BLE001 - teardown must not mask
+            # Teardown must not mask the rank error raised right below;
+            # nothing is blocked on these closed datasets:
+            # rsdl-lint: disable=swallowed-exception
+            except Exception:  # noqa: BLE001
                 pass
         for t in threads:
             t.join(timeout=60)
@@ -742,11 +755,15 @@ def main() -> None:
                 cold=cold, device_rebatch=device_rebatch, step_ms=step_ms,
                 qname=qname, num_trainers=num_trainers,
                 max_inflight_bytes=max_inflight_bytes, spill_dir=spill_dir)
+        # Same budget/spill plumbing as the multi-trainer path: the JSON
+        # record claims these knobs were engaged whenever they are set,
+        # so the single-trainer phases must actually apply them too.
         return run_ingest(
             jax, filenames, num_epochs=epochs, batch_size=batch_size,
             num_reducers=num_reducers, prefetch_size=prefetch_size,
             cold=cold, device_rebatch=device_rebatch, step_ms=step_ms,
-            qname=qname)
+            qname=qname, max_inflight_bytes=max_inflight_bytes,
+            spill_dir=spill_dir)
 
     with maybe_profile():
         if "cached" in phases:

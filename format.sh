@@ -24,4 +24,15 @@ else
     echo "-- flake8 not installed, skipping"
 fi
 
+# Project-invariant analyzer (analysis/ is stdlib-only, but importing it
+# goes through the package __init__, which needs numpy/pyarrow — skip
+# gracefully on images without them, same pattern as yapf/flake8 above).
+if python -c 'import ray_shuffling_data_loader_tpu.analysis' 2>/dev/null; then
+    echo "-- rsdl-lint"
+    python -m ray_shuffling_data_loader_tpu.analysis \
+        "${PY_DIRS[@]}" bench.py __graft_entry__.py tools
+else
+    echo "-- rsdl-lint deps not importable, skipping"
+fi
+
 echo "OK"

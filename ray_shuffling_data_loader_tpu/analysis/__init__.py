@@ -1,0 +1,19 @@
+"""rsdl-lint: project-invariant static analyzer for this pipeline.
+
+Run ``python -m ray_shuffling_data_loader_tpu.analysis <paths>`` (or
+``tools/rsdl_lint.py``); see ``--list-rules`` for the rule set and
+``examples/static_analysis.md`` for the invariants each rule encodes
+and the ``# rsdl-lint: disable=<rule>`` pragma syntax. Stdlib-only by
+design so the format.sh gate runs on minimal TPU-VM images.
+"""
+
+from ray_shuffling_data_loader_tpu.analysis.core import (Config, Rule,
+                                                         Violation,
+                                                         all_rules,
+                                                         check_paths,
+                                                         check_source)
+
+__all__ = [
+    "Config", "Rule", "Violation", "all_rules", "check_paths",
+    "check_source",
+]

@@ -1,0 +1,7 @@
+"""``python -m ray_shuffling_data_loader_tpu.analysis`` entry point."""
+
+import sys
+
+from ray_shuffling_data_loader_tpu.analysis.cli import main
+
+sys.exit(main())

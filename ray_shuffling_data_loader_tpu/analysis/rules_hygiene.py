@@ -1,0 +1,62 @@
+"""Worker-thread hygiene rules.
+
+Every stage of this pipeline runs on background threads feeding bounded
+queues. An `except: pass` (or ``except Exception: pass``) in that
+topology does not just lose a traceback — it silently drops the
+sentinel/batch the consumer is blocked on, stranding it forever (the
+exact failure mode ShuffleFailure/poison-pill machinery exists to
+prevent). Narrow handlers (``except OSError: pass`` around best-effort
+cleanup) are fine and are not flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_shuffling_data_loader_tpu.analysis.core import (FileContext, Rule,
+                                                         Violation, register)
+
+_BROAD = {"Exception", "BaseException"}
+
+
+def _is_broad(type_node) -> bool:
+    if type_node is None:
+        return True  # bare except
+    if isinstance(type_node, ast.Name):
+        return type_node.id in _BROAD
+    if isinstance(type_node, ast.Tuple):
+        return any(_is_broad(t) for t in type_node.elts)
+    return False
+
+
+def _is_noop(body) -> bool:
+    for stmt in body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+            continue  # docstring / `...`
+        return False
+    return True
+
+
+@register
+class SwallowedExceptionRule(Rule):
+    id = "swallowed-exception"
+    category = "hygiene"
+    description = ("broad `except:`/`except Exception:` with a pass-only "
+                   "body swallows worker failures and strands queue "
+                   "consumers")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            if _is_broad(node.type) and _is_noop(node.body):
+                yield ctx.violation(
+                    self, node,
+                    "a swallowed broad exception in a worker thread drops "
+                    "the batch/sentinel its consumer is blocked on; catch "
+                    "the specific exception, or log and forward the "
+                    "failure (ShuffleFailure / on_failure hook)")

@@ -1,0 +1,176 @@
+"""JAX/TPU hot-path hygiene rules.
+
+The ingest pipeline's throughput rests on keeping the host out of the
+device path: the prefetch producer must never synchronize with the
+device (a ``.block_until_ready()`` / ``device_get`` inside its loop
+serializes transfer against compute and shows up directly as trainer
+stall %), a jitted function must never force a trace-time host sync
+(``float(x)`` / ``np.asarray(x)`` on a traced value aborts tracing or
+silently constant-folds), and ``jax.device_put`` in the SPMD layers
+must carry an explicit sharding — an unsharded put materializes the
+whole array on device 0 and the next collective pays a full reshard.
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+from typing import Dict, Iterator, List, Optional, Set
+
+from ray_shuffling_data_loader_tpu.analysis.core import (FileContext, Rule,
+                                                         Violation,
+                                                         dotted_name,
+                                                         keyword_names,
+                                                         register)
+
+#: Builtin conversions that force a host sync on a traced/device value.
+_SYNC_BUILTINS = {"float", "int", "bool"}
+#: Dotted tails that copy device values to host.
+_SYNC_FUNCTIONS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                   "jax.device_get", "device_get"}
+#: Method calls that synchronize with the device.
+_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+#: Device syncs worth flagging inside prefetch/ingest hot loops (host
+#: numpy work is normal there, so the builtin/np.* set does not apply).
+_LOOP_SYNC_METHODS = {"block_until_ready", "item"}
+_LOOP_SYNC_FUNCTIONS = {"jax.block_until_ready", "jax.device_get",
+                        "device_get"}
+
+
+def _is_jit_expr(node: ast.expr) -> bool:
+    """``jax.jit`` / ``jit`` / ``partial(jax.jit, ...)`` / ``jax.jit(...)``
+    (a configured jit used as a decorator factory)."""
+    if isinstance(node, ast.Call):
+        name = dotted_name(node.func)
+        if name.rsplit(".", 1)[-1] == "partial" and node.args:
+            return _is_jit_expr(node.args[0])
+        return name.rsplit(".", 1)[-1] == "jit"
+    return dotted_name(node).rsplit(".", 1)[-1] == "jit"
+
+
+class _JitIndex:
+    """Which function bodies in a module execute under jax.jit."""
+
+    def __init__(self, tree: ast.Module):
+        self.defs: Dict[str, List[ast.AST]] = {}
+        self.jitted_names: Set[str] = set()
+        self.jitted_lambdas: List[ast.Lambda] = []
+        self.decorated: List[ast.AST] = []
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.defs.setdefault(node.name, []).append(node)
+                if any(_is_jit_expr(d) for d in node.decorator_list):
+                    self.decorated.append(node)
+            elif isinstance(node, ast.Call) and _is_jit_expr(node.func) \
+                    and node.args:
+                target = node.args[0]
+                if isinstance(target, ast.Name):
+                    self.jitted_names.add(target.id)
+                elif isinstance(target, ast.Lambda):
+                    self.jitted_lambdas.append(target)
+
+    def jitted_bodies(self) -> Iterator[ast.AST]:
+        seen: Set[int] = set()
+        for node in self.decorated:
+            seen.add(id(node))
+            yield node
+        for name in self.jitted_names:
+            for node in self.defs.get(name, []):
+                if id(node) not in seen:
+                    seen.add(id(node))
+                    yield node
+        yield from self.jitted_lambdas
+
+
+@register
+class JaxHostSyncRule(Rule):
+    id = "jax-host-sync"
+    category = "jax-hygiene"
+    description = ("host synchronization (float()/np.asarray/.item()/"
+                   ".block_until_ready()) inside a jitted function or a "
+                   "prefetch hot loop")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        index = _JitIndex(tree)
+        for body in index.jitted_bodies():
+            yield from self._check_jitted(body, ctx)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and any(fnmatch.fnmatch(node.name, pat)
+                            for pat in ctx.config.hot_loop_functions):
+                yield from self._check_hot_loops(node, ctx)
+
+    def _check_jitted(self, fn: ast.AST,
+                      ctx: FileContext) -> Iterator[Violation]:
+        for node in ast.walk(fn):
+            if node is not fn and isinstance(
+                    node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue  # nested defs are visited as their own entries
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted_name(node.func)
+            reason = None
+            if isinstance(node.func, ast.Name) \
+                    and node.func.id in _SYNC_BUILTINS:
+                reason = f"`{node.func.id}()` forces a host sync"
+            elif name in _SYNC_FUNCTIONS:
+                reason = f"`{name}` copies the value to host"
+            elif isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in _SYNC_METHODS:
+                reason = f"`.{node.func.attr}()` synchronizes with the " \
+                         "device"
+            if reason is not None:
+                yield ctx.violation(
+                    self, node,
+                    f"{reason} inside a jit-compiled function; trace-time "
+                    "sync either fails on tracers or silently "
+                    "constant-folds — keep host conversions outside jit")
+
+    def _check_hot_loops(self, fn: ast.AST,
+                         ctx: FileContext) -> Iterator[Violation]:
+        loops = [n for n in ast.walk(fn)
+                 if isinstance(n, (ast.For, ast.While, ast.AsyncFor))]
+        seen: Set[int] = set()
+        for loop in loops:
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                name = dotted_name(node.func)
+                hit = (name in _LOOP_SYNC_FUNCTIONS
+                       or (isinstance(node.func, ast.Attribute)
+                           and node.func.attr in _LOOP_SYNC_METHODS))
+                if hit:
+                    seen.add(id(node))
+                    yield ctx.violation(
+                        self, node,
+                        f"`{name}` inside the `{fn.name}` hot loop "
+                        "serializes host against device; prefetch loops "
+                        "must stay async (device_put returns before the "
+                        "copy lands)")
+
+
+@register
+class DevicePutUnshardedRule(Rule):
+    id = "device-put-unsharded"
+    category = "jax-hygiene"
+    description = ("`jax.device_put` without an explicit sharding/device "
+                   "in SPMD (parallel/) code paths")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        if not ctx.path_matches(ctx.config.sharded_path_globs):
+            return
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if dotted_name(node.func).rsplit(".", 1)[-1] != "device_put":
+                continue
+            if len(node.args) >= 2 or "device" in keyword_names(node):
+                continue
+            yield ctx.violation(
+                self, node,
+                "`jax.device_put` without a sharding in an SPMD path "
+                "lands the whole array on the default device; pass a "
+                "`NamedSharding` (second argument) so the batch axis is "
+                "laid out over the mesh")

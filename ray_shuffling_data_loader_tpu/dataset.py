@@ -378,7 +378,12 @@ def slice_batches(tables: Iterator[pa.Table], batch_size: int,
             carry_rows += take
             offset = take
             if carry_rows == batch_size:
-                yield pa.concat_tables(carry)
+                # permissive promotion: the >2GiB fallback promotes
+                # offsets PER REDUCER OUTPUT (shuffle.py), so one epoch
+                # stream may legally mix large_* and 32-bit-offset
+                # schemas and an unpromoted concat would raise
+                # ArrowInvalid exactly in the huge-corpus regime.
+                yield pa.concat_tables(carry, promote_options="permissive")
                 carry = []
                 carry_rows = 0
         # Yield full batches straight out of this table, zero-copy.
@@ -390,7 +395,7 @@ def slice_batches(tables: Iterator[pa.Table], batch_size: int,
             carry.append(table.slice(offset))
             carry_rows += num_rows - offset
     if carry_rows and not drop_last:
-        yield pa.concat_tables(carry)
+        yield pa.concat_tables(carry, promote_options="permissive")
 
 
 if __name__ == "__main__":

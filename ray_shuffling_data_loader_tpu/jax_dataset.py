@@ -811,6 +811,9 @@ class JaxShufflingDataset:
                     "this dataset")
             self._active_gen = None
         assert epoch == self._next_epoch, (epoch, self._next_epoch)
+        # The lock guards only the skip MAPS shared with the producer
+        # thread; _consumer_skip is consumer-thread-owned (set_epoch and
+        # the iterator run on the same thread) and is assigned outside.
         with self._lock:
             if epoch in self._started_epochs:
                 # Producer already ran (or is running) this epoch's convert+
@@ -818,7 +821,7 @@ class JaxShufflingDataset:
                 # minus whatever a previous set_epoch call for this epoch
                 # already had the producer skip at the Arrow level.
                 already = self._scheduled_skips.get(epoch, 0)
-                self._consumer_skip = max(0, skip_batches - already)
+                consumer_skip = max(0, skip_batches - already)
             else:
                 # Cheap path: the producer will skip at the Arrow-slice
                 # level, before any conversion or transfer. Keep the two
@@ -829,7 +832,8 @@ class JaxShufflingDataset:
                 else:
                     self._pending_skips.pop(epoch, None)
                 self._scheduled_skips[epoch] = skip_batches
-                self._consumer_skip = 0
+                consumer_skip = 0
+        self._consumer_skip = consumer_skip
         self._epoch_set = True
 
     @property

@@ -556,20 +556,38 @@ def shuffle_reduce(reduce_index: int,
     return shuffled
 
 
+def _promote_offset_type(t: pa.DataType) -> pa.DataType:
+    """64-bit-offset (``large_*``) form of ``t``, recursing into nested
+    value types: ``list<string>`` becomes ``large_list<large_string>``
+    (a promoted outer list with 32-bit child offsets would re-raise
+    ArrowInvalid on the retried take when the CHILD data exceeds 2 GiB).
+    Fixed-size lists keep their width but promote their children; struct
+    fields promote independently."""
+    if pa.types.is_binary(t):
+        return pa.large_binary()
+    if pa.types.is_string(t):
+        return pa.large_string()
+    if pa.types.is_list(t) or pa.types.is_large_list(t):
+        return pa.large_list(_promote_offset_type(t.value_type))
+    if pa.types.is_fixed_size_list(t):
+        return pa.list_(_promote_offset_type(t.value_type), t.list_size)
+    if pa.types.is_struct(t):
+        return pa.struct([
+            field.with_type(_promote_offset_type(field.type)) for field in t
+        ])
+    return t
+
+
 def _promote_large_offsets(table: pa.Table) -> pa.Table:
-    """Cast 32-bit-offset variable-width columns (binary/string/list) to
-    their 64-bit ``large_*`` forms so a single reducer output may exceed
-    2 GiB of variable-width data."""
+    """Cast 32-bit-offset variable-width columns (binary/string/list,
+    including nested children) to their 64-bit ``large_*`` forms so a
+    single reducer output may exceed 2 GiB of variable-width data."""
     fields = []
     changed = False
     for field in table.schema:
-        t = field.type
-        if pa.types.is_binary(t):
-            t, changed = pa.large_binary(), True
-        elif pa.types.is_string(t):
-            t, changed = pa.large_string(), True
-        elif pa.types.is_list(t):
-            t, changed = pa.large_list(t.value_type), True
+        t = _promote_offset_type(field.type)
+        if t != field.type:
+            changed = True
         fields.append(field.with_type(t))
     if not changed:
         return table
@@ -607,7 +625,11 @@ def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
         tables = [
             c.materialize() if isinstance(c, LazyChunk) else c for c in chunks
         ]
-        table = pa.concat_tables(tables)
+        # permissive promotion: a map-side transform (or a partially
+        # promoted cross-host stream) may hand this reducer chunks whose
+        # schemas differ only in offset width; unifying them here keeps
+        # the fallback alive in exactly the regime it serves.
+        table = pa.concat_tables(tables, promote_options="permissive")
         perm = ops.permutation(table.num_rows,
                                ops.reduce_rng(seed, epoch, reduce_index))
         try:

@@ -30,6 +30,21 @@ from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
 
+# Process-wide spill totals across every SpillManager, for assertions
+# and monitoring that must not depend on log level or manager lifetime
+# (a manager may already be finalized when its consumer checks).
+_totals_lock = threading.Lock()
+_total_spill_count = 0
+_total_spilled_bytes = 0
+
+
+def process_spill_totals() -> "tuple[int, int]":
+    """``(spill_count, spilled_bytes)`` accumulated by every
+    :class:`SpillManager` in this process since import. Monotonic;
+    snapshot before/after a run to measure that run's spill activity."""
+    with _totals_lock:
+        return _total_spill_count, _total_spilled_bytes
+
 
 class SpilledTable:
     """Lazy handle to one reducer output on disk.
@@ -118,6 +133,10 @@ class SpillManager:
         with self._lock:
             self.spill_count += 1
             self.spilled_bytes += size
+        global _total_spill_count, _total_spilled_bytes
+        with _totals_lock:
+            _total_spill_count += 1
+            _total_spilled_bytes += size
         return SpilledTable(path, table.num_rows, self)
 
     def report(self) -> None:

@@ -1,0 +1,431 @@
+"""rsdl-lint: one positive + one negative fixture per rule, framework
+behavior (pragmas, baseline, CLI/exit codes), and a clean run over the
+real tree.
+
+Fixtures live in string literals, which the analyzer's AST walk never
+sees when it scans THIS file — so seeding a violation here cannot fail
+the real-tree gate below.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_shuffling_data_loader_tpu.analysis import baseline as baseline_mod
+from ray_shuffling_data_loader_tpu.analysis import cli, core
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+#: The trees the format.sh gate runs over.
+GATE_PATHS = ["ray_shuffling_data_loader_tpu", "tests", "benchmarks",
+              "examples", "bench.py", "__graft_entry__.py", "tools"]
+
+
+def lint(source, path="pkg/mod.py", **config_kwargs):
+    config = core.Config(**config_kwargs) if config_kwargs else None
+    violations = core.check_source(textwrap.dedent(source), path, config)
+    return [v.rule for v in violations], violations
+
+
+# ---------------------------------------------------------------------------
+# Rule fixtures: (rule id, flagged source, clean source)
+# ---------------------------------------------------------------------------
+
+LOCK_MUTATION_BAD = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._bytes = 0
+
+        def put(self, n):
+            with self._lock:
+                self._bytes += n
+
+        def reset(self):
+            self._bytes = 0  # unguarded write to a guarded attribute
+"""
+
+LOCK_MUTATION_OK = """
+    import threading
+
+    class Cache:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._bytes = 0
+
+        def put(self, n):
+            with self._lock:
+                self._bytes += n
+
+        def reset(self):
+            with self._lock:
+                self._bytes = 0
+"""
+
+LOCK_BLOCKING_BAD = """
+    import threading
+
+    class Pipeline:
+        def __init__(self, queue):
+            self._lock = threading.Lock()
+            self._queue = queue
+
+        def drain(self, ref):
+            with self._lock:
+                table = ref.result()
+                item = self._queue.get(0)
+            return table, item
+"""
+
+LOCK_BLOCKING_OK = """
+    import threading
+
+    class Pipeline:
+        def __init__(self, queue):
+            self._lock = threading.Lock()
+            self._queue = queue
+
+        def drain(self, ref):
+            table = ref.result()
+            item = self._queue.get(0, timeout=5.0)
+            with self._lock:
+                self._held = (table, item)
+            return table, item
+"""
+
+ONESHOT_BAD = """
+    def reduce_task(transport, tag):
+        payload = transport.recv(0, tag)
+        return payload
+
+    def launch(pool, transport, tag):
+        return pool.submit(reduce_task, transport, tag)
+"""
+
+ONESHOT_OK = """
+    def reduce_task(transport, tag):
+        payload = transport.recv(0, tag)
+        return payload
+
+    def launch(pool, transport, tag):
+        return pool.submit_once(reduce_task, transport, tag)
+"""
+
+UNSEEDED_BAD = """
+    import numpy as np
+
+    def assign(num_rows, num_reducers):
+        return np.random.randint(num_reducers, size=num_rows)
+"""
+
+UNSEEDED_OK = """
+    import numpy as np
+
+    def assign(num_rows, num_reducers, seed, epoch, task):
+        rng = np.random.Generator(np.random.Philox(
+            np.random.SeedSequence(entropy=seed,
+                                   spawn_key=(epoch, task))))
+        return rng.integers(num_reducers, size=num_rows)
+"""
+
+HOST_SYNC_JIT_BAD = """
+    import jax
+    import numpy as np
+
+    @jax.jit
+    def step(x):
+        scale = float(x.sum())  # trace-time host sync
+        return x * scale
+"""
+
+HOST_SYNC_LOOP_BAD = """
+    def producer(dataset, out):
+        for batch in dataset:
+            batch.block_until_ready()
+            out.put(batch)
+"""
+
+HOST_SYNC_OK = """
+    import jax
+
+    @jax.jit
+    def step(x):
+        return x * x.sum()
+
+    def producer(dataset, out):
+        for batch in dataset:
+            out.put(batch)
+"""
+
+DEVICE_PUT_BAD = """
+    import jax
+
+    def ship(batch):
+        return jax.device_put(batch)
+"""
+
+DEVICE_PUT_OK = """
+    import jax
+
+    def ship(batch, sharding):
+        return jax.device_put(batch, sharding)
+"""
+
+CONCAT_BAD = """
+    import pyarrow as pa
+
+    def rebatch(carry):
+        return pa.concat_tables(carry)
+"""
+
+CONCAT_OK = """
+    import pyarrow as pa
+
+    def rebatch(carry):
+        return pa.concat_tables(carry, promote_options="permissive")
+"""
+
+ZERO_COPY_BAD = """
+    def to_host(column):
+        return column.to_numpy(zero_copy_only=True)
+"""
+
+ZERO_COPY_OK = """
+    def to_host(column):
+        return column.combine_chunks().to_numpy(zero_copy_only=False)
+"""
+
+SWALLOWED_BAD = """
+    def worker(queue):
+        try:
+            queue.put(1)
+        except Exception:
+            pass
+"""
+
+SWALLOWED_OK = """
+    def worker(queue, logger):
+        try:
+            queue.put(1)
+        except OSError:
+            pass  # narrow, best-effort cleanup
+        except Exception as e:
+            logger.exception("worker failed: %s", e)
+            raise
+"""
+
+CASES = [
+    ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
+    ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
+    ("oneshot-submit", ONESHOT_BAD, ONESHOT_OK, {}),
+    ("unseeded-random", UNSEEDED_BAD, UNSEEDED_OK, {}),
+    ("jax-host-sync", HOST_SYNC_JIT_BAD, HOST_SYNC_OK, {}),
+    ("jax-host-sync", HOST_SYNC_LOOP_BAD, HOST_SYNC_OK, {}),
+    ("device-put-unsharded", DEVICE_PUT_BAD, DEVICE_PUT_OK,
+     {"path": "pkg/parallel/mod.py"}),
+    ("arrow-concat-promote", CONCAT_BAD, CONCAT_OK, {}),
+    ("arrow-zero-copy", ZERO_COPY_BAD, ZERO_COPY_OK, {}),
+    ("swallowed-exception", SWALLOWED_BAD, SWALLOWED_OK, {}),
+]
+
+
+@pytest.mark.parametrize("rule_id,bad,good,kwargs",
+                         CASES, ids=[f"{c[0]}-{i}"
+                                     for i, c in enumerate(CASES)])
+def test_rule_positive_and_negative(rule_id, bad, good, kwargs):
+    path = kwargs.get("path", "pkg/mod.py")
+    flagged, _ = lint(bad, path=path)
+    assert rule_id in flagged, f"{rule_id} missed its seeded violation"
+    clean, violations = lint(good, path=path)
+    assert rule_id not in clean, \
+        f"{rule_id} false-positive on the clean fixture: {violations}"
+
+
+def test_at_least_eight_distinct_rules_registered():
+    assert len(core.all_rules()) >= 8
+
+
+def test_rule_count_matches_fixture_coverage():
+    assert set(core.all_rules()) == {case[0] for case in CASES}
+
+
+def test_lock_blocking_ignores_dict_get():
+    _, violations = lint("""
+        import threading
+
+        class Registry:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._entries = {}
+
+            def lookup(self, key):
+                with self._lock:
+                    return self._entries.get(key)
+    """)
+    assert violations == []
+
+
+def test_lock_mutation_skips_init_and_nested_defs():
+    _, violations = lint("""
+        import threading
+
+        class Cache:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._bytes = 0  # pre-publication write: exempt
+
+            def put(self, n):
+                with self._lock:
+                    self._bytes += n
+
+                def rollback():
+                    # runs later on another thread, not under this lock
+                    self._bytes -= n
+                return rollback
+    """)
+    assert [v.rule for v in violations] == ["lock-mutation"]
+
+
+def test_device_put_rule_scoped_to_parallel_paths():
+    flagged, _ = lint(DEVICE_PUT_BAD, path="pkg/jax_dataset.py")
+    assert "device-put-unsharded" not in flagged
+
+
+def test_pragma_suppresses_on_line_and_from_line_above():
+    src = """
+        import pyarrow as pa
+
+        def rebatch(carry, tail):
+            a = pa.concat_tables(carry)  # rsdl-lint: disable=arrow-concat-promote
+            # schema is homogeneous here: rsdl-lint: disable=arrow-concat-promote
+            b = pa.concat_tables(tail)
+            return a, b
+    """
+    flagged, _ = lint(src)
+    assert flagged == []
+
+
+def test_pragma_file_level_and_all():
+    src = """
+        # rsdl-lint: disable-file=arrow-concat-promote
+        import pyarrow as pa
+
+        def rebatch(carry):
+            return pa.concat_tables(carry)
+    """
+    assert lint(src)[0] == []
+    src_all = """
+        import pyarrow as pa
+
+        def rebatch(carry):
+            return pa.concat_tables(carry)  # rsdl-lint: disable=all
+    """
+    assert lint(src_all)[0] == []
+
+
+def test_pragma_does_not_leak_to_other_rules():
+    src = """
+        import pyarrow as pa
+
+        def rebatch(carry):
+            return pa.concat_tables(carry)  # rsdl-lint: disable=unseeded-random
+    """
+    assert lint(src)[0] == ["arrow-concat-promote"]
+
+
+def test_parse_error_is_reported_not_raised():
+    flagged, violations = lint("def broken(:\n")
+    assert flagged == ["parse-error"]
+    assert violations[0].line >= 1
+
+
+def test_baseline_roundtrip_suppresses_exact_occurrences(tmp_path):
+    _, violations = lint(CONCAT_BAD)
+    assert len(violations) == 1
+    path = tmp_path / "baseline.json"
+    baseline_mod.write_baseline(str(path), violations)
+    allowed = baseline_mod.load_baseline(str(path))
+    remaining, suppressed = baseline_mod.apply_baseline(violations, allowed)
+    assert remaining == [] and suppressed == 1
+    # A SECOND occurrence of the same finding is NOT grandfathered.
+    doubled = violations + violations
+    remaining, suppressed = baseline_mod.apply_baseline(doubled, allowed)
+    assert len(remaining) == 1 and suppressed == 1
+
+
+def _write(tmp_path, name, source):
+    target = tmp_path / name
+    target.parent.mkdir(parents=True, exist_ok=True)
+    target.write_text(textwrap.dedent(source))
+    return target
+
+
+def test_cli_exit_codes_and_json(tmp_path, monkeypatch, capsys):
+    _write(tmp_path, "dirty.py", CONCAT_BAD)
+    monkeypatch.chdir(tmp_path)
+    assert cli.main(["dirty.py"]) == core.EXIT_VIOLATIONS
+    capsys.readouterr()
+    assert cli.main(["dirty.py", "--format", "json"]) \
+        == core.EXIT_VIOLATIONS
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["files_checked"] == 1
+    assert [v["rule"] for v in payload["violations"]] \
+        == ["arrow-concat-promote"]
+    # Baseline it, then the same tree gates clean.
+    assert cli.main(["dirty.py", "--write-baseline"]) == core.EXIT_CLEAN
+    capsys.readouterr()
+    assert cli.main(["dirty.py"]) == core.EXIT_CLEAN
+    assert cli.main(["dirty.py", "--no-baseline"]) == core.EXIT_VIOLATIONS
+    capsys.readouterr()
+    assert cli.main(["no/such/path.py"]) == core.EXIT_ERROR
+    assert cli.main(["dirty.py", "--select", "not-a-rule"]) \
+        == core.EXIT_ERROR
+
+
+def test_cli_select_and_disable(tmp_path, monkeypatch, capsys):
+    _write(tmp_path, "dirty.py", CONCAT_BAD)
+    monkeypatch.chdir(tmp_path)
+    assert cli.main(["dirty.py", "--disable", "arrow-concat-promote"]) \
+        == core.EXIT_CLEAN
+    capsys.readouterr()
+    assert cli.main(["dirty.py", "--select", "unseeded-random"]) \
+        == core.EXIT_CLEAN
+
+
+def test_cli_config_override(tmp_path, monkeypatch):
+    _write(tmp_path, "parallelish.py", DEVICE_PUT_BAD)
+    config = tmp_path / "lint.json"
+    config.write_text(json.dumps({"sharded_path_globs": ["*parallelish*"]}))
+    monkeypatch.chdir(tmp_path)
+    assert cli.main(["parallelish.py", "--config", str(config)]) \
+        == core.EXIT_VIOLATIONS
+    assert cli.main(["parallelish.py"]) == core.EXIT_CLEAN
+    bad_config = tmp_path / "bad.json"
+    bad_config.write_text(json.dumps({"no_such_knob": 1}))
+    assert cli.main(["parallelish.py", "--config", str(bad_config)]) \
+        == core.EXIT_ERROR
+
+
+def test_real_tree_is_clean_modulo_baseline():
+    """The acceptance gate: the analyzer over the actual repo trees exits
+    0, in-process (fast) — every deliberate exception is pragma'd."""
+    rc = cli.main(["--baseline",
+                   os.path.join(REPO_ROOT, ".rsdl-lint-baseline.json")]
+                  + [os.path.join(REPO_ROOT, p) for p in GATE_PATHS])
+    assert rc == core.EXIT_CLEAN
+
+
+def test_module_entry_point_runs():
+    """`python -m ray_shuffling_data_loader_tpu.analysis` works as the
+    format.sh gate invokes it (subprocess, repo root cwd)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ray_shuffling_data_loader_tpu.analysis",
+         "--list-rules"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == core.EXIT_CLEAN, proc.stderr
+    assert "arrow-concat-promote" in proc.stdout

@@ -66,7 +66,10 @@ def test_fused_reduce_matches_materialized(tmp_path):
         materialized = sh.shuffle_reduce(
             r, seed=9, epoch=1, chunks=[s[r].materialize() for s in shards])
         assert fused.equals(materialized)
-        # Cross-check against the unfused reference formulation.
+        # Cross-check against the unfused reference formulation. The
+        # chunks are slices of identically-typed generated shards, so
+        # the bit-identity oracle needs no schema promotion:
+        # rsdl-lint: disable=arrow-concat-promote
         concat = pa.concat_tables([s[r].materialize() for s in shards])
         from ray_shuffling_data_loader_tpu.ops import partition as ops
         perm = ops.permutation(concat.num_rows, ops.reduce_rng(9, 1, r))
@@ -179,6 +182,54 @@ def test_promote_large_offsets_preserves_content():
     # No variable-width columns: the table is returned unchanged.
     plain = pa.table({"i": pa.array([1, 2], type=pa.int64())})
     assert sh._promote_large_offsets(plain) is plain
+
+
+def test_promote_large_offsets_recurses_into_nested_types():
+    """Nested variable-width children must get 64-bit offsets too: a
+    promoted large_list<string> whose CHILD offsets stay 32-bit re-raises
+    ArrowInvalid on the retried take when the child data exceeds 2 GiB
+    (ADVICE r5). list/fixed_size_list/struct children all promote."""
+    columns = {
+        "ls": pa.array([["a", "bb"], [], ["c"]],
+                       type=pa.list_(pa.string())),
+        "fsl": pa.array([[b"x", b"y"], [b"", b"z"], [b"q", b"r"]],
+                        type=pa.list_(pa.binary(), 2)),
+        "st": pa.array([{"name": "n", "tags": ["t1", "t2"]},
+                        {"name": "", "tags": []},
+                        {"name": "m", "tags": ["t3"]}],
+                       type=pa.struct([("name", pa.string()),
+                                       ("tags",
+                                        pa.list_(pa.string()))])),
+        "deep": pa.array([[["a"], []], [["bb", "c"]], []],
+                         type=pa.list_(pa.list_(pa.string()))),
+    }
+    table = pa.table(columns)
+    out = sh._promote_large_offsets(table)
+    for name in table.column_names:
+        assert out.column(name).to_pylist() == \
+            table.column(name).to_pylist()
+    promoted = {
+        name: sh._promote_offset_type(table.schema.field(name).type)
+        for name in table.column_names
+    }
+    for name in table.column_names:
+        assert out.schema.field(name).type == promoted[name]
+    assert promoted["ls"] == pa.large_list(pa.large_string())
+    assert promoted["fsl"] == pa.list_(pa.large_binary(), 2)
+    assert promoted["st"] == pa.struct([
+        ("name", pa.large_string()),
+        ("tags", pa.large_list(pa.large_string())),
+    ])
+    assert promoted["deep"] == pa.large_list(
+        pa.large_list(pa.large_string()))
+    # Idempotent: an already-promoted type maps to itself, so a retried
+    # promotion (or a pre-promoted cross-host chunk) is a no-op.
+    for t in promoted.values():
+        assert sh._promote_offset_type(t) == t
+    # take on the promoted table matches take on the original (the
+    # operation whose retry the promotion exists to make succeed).
+    assert out.take([2, 0, 1]).to_pylist() == \
+        table.take([2, 0, 1]).to_pylist()
 
 
 def test_disk_table_cache_roundtrip_budget_and_close(tmp_path):

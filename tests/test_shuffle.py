@@ -44,12 +44,16 @@ class CollectingConsumer:
         self.lock = threading.Lock()
 
     def __call__(self, rank, epoch, refs):
-        with self.lock:
-            if refs is None:
+        if refs is None:
+            with self.lock:
                 self.sentinels.append((rank, epoch))
-            else:
-                self.tables[(rank, epoch)].extend(
-                    ref.result() for ref in refs)
+        else:
+            # Resolve the reduce futures BEFORE taking the lock: holding
+            # it across ref.result() would serialize every concurrent
+            # consumer behind the slowest reducer.
+            tables = [ref.result() for ref in refs]
+            with self.lock:
+                self.tables[(rank, epoch)].extend(tables)
 
     def epoch_keys(self, epoch, num_trainers):
         keys = []

@@ -59,6 +59,29 @@ def test_no_spill_under_budget(tmp_path):
     assert mgr.spill_count == 0
 
 
+def test_process_spill_totals_accumulate_across_managers(tmp_path):
+    """The process-wide totals the dryrun asserts on: they track every
+    manager's spills (log-level independent, unlike the old log-scrape)
+    and survive the manager itself being dropped."""
+    table = pa.table({"a": np.arange(50, dtype=np.int64)})
+    count0, bytes0 = spill_mod.process_spill_totals()
+    for sub in ("m1", "m2"):
+        mgr = spill_mod.SpillManager(str(tmp_path / sub),
+                                     over_budget=lambda: True)
+        handle = mgr.maybe_spill(table)
+        assert handle.load().equals(table)
+        del mgr, handle
+    gc.collect()
+    count1, bytes1 = spill_mod.process_spill_totals()
+    assert count1 - count0 == 2
+    assert bytes1 > bytes0
+    # Under budget: the totals do not move.
+    mgr = spill_mod.SpillManager(str(tmp_path / "m3"),
+                                 over_budget=lambda: False)
+    assert mgr.maybe_spill(table) is table
+    assert spill_mod.process_spill_totals() == (count1, bytes1)
+
+
 def test_unwrap_passthrough():
     table = pa.table({"a": [1, 2]})
     assert spill_mod.unwrap(table) is table

@@ -1,0 +1,21 @@
+#!/usr/bin/env python
+"""Repo-root launcher for rsdl-lint.
+
+Equivalent to ``python -m ray_shuffling_data_loader_tpu.analysis`` but
+runnable from anywhere (it pins sys.path to the repo checkout that
+contains it), e.g. as an editor/file-watcher hook::
+
+    tools/rsdl_lint.py ray_shuffling_data_loader_tpu tests benchmarks
+"""
+
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+from ray_shuffling_data_loader_tpu.analysis.cli import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
