@@ -57,7 +57,15 @@ RSDL_BENCH_INFLIGHT_BYTES (transient-byte budget for the ingest phases),
 RSDL_BENCH_SPILL_DIR (with the budget: spill tier for reducer outputs),
 RSDL_BENCH_SCAN_STEPS=1 (train phase: one lax.scan call per chunk
 instead of per-micro-step dispatch — see the note in run_train),
-RSDL_BENCH_DEVICE_TABLE_BYTES (bulk-path per-chunk transfer cap).
+RSDL_BENCH_DEVICE_TABLE_BYTES (bulk-path per-chunk transfer cap),
+RSDL_BENCH_RUNS (train-phase repeats for the median-of-N contract
+fields + congestion marker; default 3 on accelerators, 1 under
+RSDL_BENCH_CPU). The JSON also carries runtime-health evidence
+(``watchdog_events``, ``stall_escalations``, ``fallback_engaged``) from
+the bulk-path progress watchdog, and the library degradation policy
+(runtime/policy.py) now owns the device-rebatch default:
+RSDL_DEVICE_REBATCH=0 is the promoted, library-wide form of
+RSDL_BENCH_DEVICE_REBATCH=0.
 """
 
 from __future__ import annotations
@@ -136,6 +144,41 @@ def _pandas_reference_baseline(filenames, num_reducers: int,
             buffer = buffer[batch_size:]
     duration = timeit.default_timer() - start
     return total_rows / duration
+
+
+def _aggregate_train_runs(runs: "list[dict]") -> dict:
+    """Median-of-N aggregation for the contract (train) phase, with a
+    congestion marker (VERDICT r5 Weak #6: a single congested run used
+    to land outside contract silently).
+
+    The quiet-host envelope is the runs' own robust spread: median
+    ``step_ms_mean`` with a MAD-derived sigma, floored at 5% of the
+    median so a perfectly tight triple doesn't flag scheduler noise. A
+    run whose step-time z-score exceeds 3 is marked congested; the
+    MEDIAN run (not the mean, not the outlier) carries the contract
+    fields, so one noisy-neighbor episode cannot sink or inflate the
+    artifact.
+    """
+    import statistics
+    step_ms = [r["step_ms_mean"] for r in runs]
+    med = statistics.median(step_ms)
+    mad = statistics.median([abs(s - med) for s in step_ms])
+    sigma = max(1.4826 * mad, 0.05 * med, 1e-9)
+    zs = [(s - med) / sigma for s in step_ms]
+    congested = [i for i, z in enumerate(zs) if z > 3.0]
+    order = sorted(range(len(runs)), key=lambda i: step_ms[i])
+    median_i = order[len(runs) // 2]
+    return {
+        "runs": len(runs),
+        "median_run_index": median_i,
+        "train_step_ms_median": round(step_ms[median_i], 3),
+        "train_rows_per_sec_median": round(runs[median_i]["rows_per_s"], 1),
+        "train_stall_pct_median": round(runs[median_i]["stall_pct"], 3),
+        "train_step_ms_runs": [round(s, 3) for s in step_ms],
+        "train_step_ms_z_max": round(max(zs), 2),
+        "congested_runs": len(congested),
+        "congested": bool(congested),
+    }
 
 
 def _cold_cache_mode() -> "str | None":
@@ -715,8 +758,14 @@ def main() -> None:
 
     # RSDL_BENCH_DEVICE_REBATCH=0 forces the per-batch host path for
     # apples-to-apples comparisons of the bulk-chunk transfer design.
+    # Unset, the choice defers to the LIBRARY degradation policy
+    # (runtime/policy.py): RSDL_DEVICE_REBATCH=0 — the promoted form of
+    # this old bench-only mitigation — now turns the per-batch path on
+    # for the library and the bench together.
+    from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
     rebatch_env = os.environ.get("RSDL_BENCH_DEVICE_REBATCH", "").strip()
-    device_rebatch = "auto" if rebatch_env == "" \
+    device_rebatch = rt_policy.resolve("bench", "device_rebatch") \
+        if rebatch_env == "" \
         else rebatch_env not in ("0", "false", "False")
 
     # Optional per-batch train-step emulation in the ingest phases (the
@@ -731,9 +780,14 @@ def main() -> None:
         if "cold" not in phases:
             phases.insert(0, "cold")
 
+    from ray_shuffling_data_loader_tpu import stats as rsdl_stats
     from ray_shuffling_data_loader_tpu.utils.tracing import maybe_profile
 
-    cached = cold = train = None
+    # Watchdog/stall totals are monotonic process counters; the JSON
+    # reports this invocation's delta.
+    wd_before = rsdl_stats.watchdog_stats().snapshot()
+
+    cached = cold = train = train_agg = None
 
     def _phase(name, fn):
         """Run one phase; a failed phase is reported and OMITTED from the
@@ -793,25 +847,47 @@ def main() -> None:
                 "tiny" if os.environ.get("RSDL_BENCH_CPU") else "mlperf")
             train_mb = int(os.environ.get("RSDL_BENCH_TRAIN_MICROBATCH",
                                           2048))
-            train = _phase("train", lambda: run_train(
-                jax, filenames, num_epochs=train_epochs,
-                batch_size=train_batch,
-                num_reducers=num_reducers,
-                prefetch_size=prefetch_size,
-                device_rebatch=device_rebatch,
-                model_size=model_size, microbatch=train_mb,
-                qname="bench-train"))
+            # Median-of-N for the CONTRACT phase (default 3 on real
+            # accelerators; 1 on the CPU smoke path, where wall-clock per
+            # run dominates CI budgets and there is no shared-host chip).
+            n_runs = max(1, int(os.environ.get(
+                "RSDL_BENCH_RUNS",
+                "1" if os.environ.get("RSDL_BENCH_CPU") else "3")))
+            train_runs = []
+            for run_i in range(n_runs):
+                r = _phase(f"train[{run_i}]", lambda run_i=run_i: run_train(
+                    jax, filenames, num_epochs=train_epochs,
+                    batch_size=train_batch,
+                    num_reducers=num_reducers,
+                    prefetch_size=prefetch_size,
+                    device_rebatch=device_rebatch,
+                    model_size=model_size, microbatch=train_mb,
+                    qname=f"bench-train-r{run_i}"))
+                if r is not None:
+                    train_runs.append(r)
+            train_agg = None
+            if train_runs:
+                train_agg = _aggregate_train_runs(train_runs)
+                train = train_runs[train_agg.pop("median_run_index")]
             if train is not None:
                 loss_txt = (f"{train['final_loss']:.4f}"
                             if train["final_loss"] is not None
                             else ("DIVERGED" if train.get("diverged")
                                   else "n/a"))
+                congestion_txt = ""
+                if train_agg is not None and train_agg["runs"] > 1:
+                    congestion_txt = (
+                        f" [median of {train_agg['runs']} runs"
+                        + (f", {train_agg['congested_runs']} CONGESTED"
+                           if train_agg["congested"] else "")
+                        + "]")
                 print(f"# train: {train['rows_per_s']:,.0f} rows/s over "
                       f"{train['batches']} real DLRM micro-steps "
                       f"({train['microbatch']} rows, "
                       f"{train['step_ms_mean']:.2f}ms each), stall "
                       f"{train['stall_pct']:.2f}% "
-                      f"(contract: <=10%), loss={loss_txt}",
+                      f"(contract: <=10%), loss={loss_txt}"
+                      f"{congestion_txt}",
                       file=sys.stderr)
 
     # The pandas baseline is a LOADER rate; it only makes sense against an
@@ -894,6 +970,17 @@ def main() -> None:
         # the timed window for cached/train, inside it for cold).
         "fill_s": round(headline.get("fill_s", 0.0), 3),
     }
+    # Runtime-health evidence (runtime/watchdog.py): deadline misses on
+    # the supervised bulk transfer/carve path, escalations (a stall
+    # persisting past further deadline multiples), and whether the
+    # automatic per-batch fallback engaged during this invocation.
+    wd_after = rsdl_stats.watchdog_stats().snapshot()
+    record["watchdog_events"] = (wd_after["watchdog_events"]
+                                 - wd_before["watchdog_events"])
+    record["stall_escalations"] = (wd_after["stall_escalations"]
+                                   - wd_before["stall_escalations"])
+    record["fallback_engaged"] = (wd_after["fallbacks_engaged"]
+                                  > wd_before["fallbacks_engaged"])
     if cold is not None:
         # "disk": parquet decoded ONCE inside the timed window, later
         # epochs stream from mmap'd Arrow IPC scratch (fresh dir per
@@ -947,6 +1034,11 @@ def main() -> None:
             "train_diverged": bool(train.get("diverged", False)),
             "train_model": f"dlrm-{train['model_size']}",
         })
+        if train_agg is not None:
+            # Median-of-N contract fields + congestion marker: the
+            # per-run train_* fields above already come from the MEDIAN
+            # run; these expose the spread and flag noisy-host episodes.
+            record.update(train_agg)
 
     print(json.dumps(record))
 

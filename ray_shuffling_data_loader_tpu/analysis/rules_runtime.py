@@ -1,0 +1,88 @@
+"""Runtime liveness/flow-control rules.
+
+The epoch-launch budget wait once flushed cycle-stuck table wrappers by
+calling ``gc.collect()`` every second inside its poll loop. That pattern
+is now structurally banned: releases are event-driven
+(``runtime/release.py`` — the ledger notifies waiters on every decref),
+and a ``gc.collect()`` inside a wait/poll loop is both a symptom (some
+path still leaks frees through reference cycles instead of breaking
+them) and a cost (a full-heap cycle collection per poll tick,
+process-wide, while holding up the very pipeline it's trying to help).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ray_shuffling_data_loader_tpu.analysis.core import (FileContext, Rule,
+                                                         Violation,
+                                                         dotted_name,
+                                                         register)
+
+#: Call tails that mark a `for` loop as a wait/poll loop (any `while`
+#: loop qualifies by itself: re-checking a condition is what it does).
+_WAIT_TAILS = {"sleep", "wait", "wait_for_release", "wait_while"}
+
+
+def _gc_collect_names(tree: ast.Module) -> Set[str]:
+    """Names that resolve to ``gc.collect`` in this module: dotted forms
+    for ``import gc`` / ``import gc as _gc``, plus bare names bound by
+    ``from gc import collect [as name]``."""
+    names: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "gc":
+                    names.add(f"{alias.asname or alias.name}.collect")
+        elif isinstance(node, ast.ImportFrom) and node.module == "gc":
+            for alias in node.names:
+                if alias.name == "collect":
+                    names.add(alias.asname or alias.name)
+    return names
+
+
+def _is_wait_loop(loop: ast.AST) -> bool:
+    if isinstance(loop, ast.While):
+        return True
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call):
+            tail = dotted_name(node.func).rsplit(".", 1)[-1]
+            if tail in _WAIT_TAILS:
+                return True
+    return False
+
+
+@register
+class GcCollectInWaitRule(Rule):
+    id = "gc-collect-in-wait"
+    category = "runtime"
+    description = ("`gc.collect()` inside a wait/poll loop — releases are "
+                   "event-driven (runtime/release.py); break the reference "
+                   "cycle at its source instead of sweeping the whole heap "
+                   "per poll tick")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        collect_names = _gc_collect_names(tree)
+        # `import gc` inside a function body is also common; cover the
+        # canonical dotted form even without a visible top-level import.
+        collect_names.add("gc.collect")
+        seen: Set[int] = set()
+        for loop in ast.walk(tree):
+            if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+                continue
+            if not _is_wait_loop(loop):
+                continue
+            for node in ast.walk(loop):
+                if not isinstance(node, ast.Call) or id(node) in seen:
+                    continue
+                if dotted_name(node.func) in collect_names:
+                    seen.add(id(node))
+                    yield ctx.violation(
+                        self, node,
+                        "`gc.collect()` inside a wait/poll loop flushes "
+                        "cycle-stuck frees by sweeping the whole heap every "
+                        "tick; releases are event-driven — wake on "
+                        "runtime.release events and break the reference "
+                        "cycle that delays the free at its source")

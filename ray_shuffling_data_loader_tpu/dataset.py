@@ -331,6 +331,11 @@ class ShufflingDataset:
                 table = table.slice(to_skip)
                 to_skip = 0
             yield table
+            # Drop the consumed table before blocking on the next get:
+            # this frame would otherwise pin it (delaying its ledger
+            # release — the budget wait in shuffle.py wakes on that
+            # release) for as long as the queue stays empty.
+            ref = raw = table = None
         self._last_epoch = self._epoch
         if (self._epoch == self._num_epochs - 1
                 and self._shuffle_result is not None):

@@ -213,7 +213,9 @@ class _BatchConverter:
                  label_column, label_shape, label_type, stack_features,
                  mesh, data_axis, device_put, device_rebatch=False,
                  device_rebatch_auto=False,
-                 max_table_bytes=512 * 1024 * 1024):
+                 max_table_bytes=512 * 1024 * 1024,
+                 watchdog=None, bulk_transfer_deadline_s=30.0,
+                 stall_action="degrade"):
         self._feature_columns = feature_columns
         self._feature_shapes = feature_shapes
         self._feature_types = feature_types
@@ -235,7 +237,36 @@ class _BatchConverter:
         # per-batch transfers instead of failing a previously-working job.
         self.device_rebatch_auto = device_rebatch_auto
         self.max_table_bytes = max_table_bytes
+        # Liveness supervision of the bulk path (runtime/watchdog.py): a
+        # chunk device_put/carve that misses the deadline is reported and
+        # — under the default "degrade" stall action — permanently drops
+        # this converter to the per-batch path (see _on_bulk_stall).
+        self.watchdog = watchdog
+        self.bulk_transfer_deadline_s = bulk_transfer_deadline_s
+        self.stall_action = stall_action
+        self.fallback_engaged = False  # a stall degraded the bulk path
         self._slicer = {}  # batch_size -> jitted batch slicer, built lazily
+
+    def _on_bulk_stall(self, report) -> None:
+        """Watchdog escalation hook — runs on the MONITOR thread (the
+        producer is, by definition, stuck inside the supervised call).
+        Caps in-flight bulk bytes so any future chunk is smaller, and
+        under the "degrade" action flips this converter to the per-batch
+        path; the producer reroutes the moment the stuck call returns.
+        """
+        from ray_shuffling_data_loader_tpu import stats as stats_mod
+        if report.escalation == 1:
+            self.max_table_bytes = max(1, self.max_table_bytes // 2)
+        if self.stall_action == "degrade" and self.device_rebatch:
+            self.device_rebatch = False
+            self.fallback_engaged = True
+            reason = (f"{report.name} stalled {report.waited_s:.2f}s "
+                      f"(deadline {report.deadline_s:.2f}s"
+                      f"{', ' + report.detail if report.detail else ''}); "
+                      "degrading to per-batch transfers")
+            stats_mod.watchdog_stats().record_fallback(
+                "jax_dataset.device_rebatch", reason)
+            logger.warning("%s", reason)
 
     def _sharding(self, ndim: int):
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -396,7 +427,8 @@ def _persistent_producer(dataset: ShufflingDataset,
                 skip = pending_skips.pop(epoch, 0)
             dataset.set_epoch(epoch, skip_batches=skip)
             if converter.device_rebatch:
-                if not _produce_epoch_tables(dataset, converter, epoch, put):
+                if not _produce_epoch_tables(dataset, converter, epoch, put,
+                                             queue_depth=out.qsize):
                     return
             else:
                 for table in dataset:
@@ -418,10 +450,44 @@ def _persistent_producer(dataset: ShufflingDataset,
 _MAX_CHUNK_BATCHES = 8
 
 
+def _supervised_transfer_table(converter: _BatchConverter, arrays_label,
+                               nb: int, bs: int, queue_depth):
+    """One bulk chunk transfer under watchdog supervision.
+
+    A wedged ``device_put`` (dying tunnel, stuck PJRT client) blocks
+    this thread indefinitely; the watchdog's monitor detects the missed
+    deadline WHILE it is stuck, files the stall (with the prefetch-queue
+    depth — 0 means the consumer is blocked waiting on this very chunk),
+    and fires the converter's escalation hook. When the call finally
+    returns, a "raise" stall action surfaces here; "degrade" reroutes in
+    the caller's loop via ``converter.device_rebatch``.
+    """
+    wd = converter.watchdog
+    if wd is None:
+        return converter.transfer_table(arrays_label, nb, bs)
+    detail_fn = None
+    if queue_depth is not None:
+        detail_fn = lambda: (  # noqa: E731
+            f"chunk={nb} batches, prefetch_queue_depth={queue_depth()} "
+            "(0 = consumer blocked)")
+    with wd.watch("jax_dataset.bulk_transfer",
+                  deadline_s=converter.bulk_transfer_deadline_s,
+                  on_stall=converter._on_bulk_stall,
+                  detail_fn=detail_fn) as handle:
+        item = converter.transfer_table(arrays_label, nb, bs)
+    if handle.stalled and converter.stall_action == "raise":
+        raise RuntimeError(
+            f"bulk device transfer stalled: ran {handle.report.waited_s:.2f}s"
+            f" against a {handle.report.deadline_s:.2f}s deadline "
+            "(stall_action='raise')")
+    return item
+
+
 def _produce_epoch_tables(dataset: ShufflingDataset,
                           converter: _BatchConverter,
                           epoch: int,
-                          put) -> bool:
+                          put,
+                          queue_depth=None) -> bool:
     """Device-rebatch producer for one epoch: bulk table transfers.
 
     Consumes RAW reducer tables (``ShufflingDataset.iter_tables``) instead
@@ -509,31 +575,38 @@ def _produce_epoch_tables(dataset: ShufflingDataset,
             # keep the jitted slicer's shape set bounded (<= one compile per
             # chunk length, reused across tables and epochs) and bound
             # per-item HBM residency: the pipeline holds at most
-            # ~(prefetch_size + 2) chunks on device at once.
-            k = min(_MAX_CHUNK_BATCHES, converter.max_table_bytes
-                    // batch_bytes)
-            if k < 1:
-                # Fat rows (a single batch exceeds the cap): per-batch
-                # transfers bound device residency.
-                for b in range(full_batches):
-                    lo = offset + b * bs
-                    with trace_span("batch_transfer"):
-                        batch = converter.transfer(
-                            ([f[lo:lo + bs] for f in features],
-                             label[lo:lo + bs]))
-                    if not put(("batch", epoch, batch)):
-                        return False
-            else:
-                for chunk_start in range(0, full_batches, k):
-                    nb = min(k, full_batches - chunk_start)
-                    lo = offset + chunk_start * bs
-                    hi = lo + nb * bs
-                    with trace_span("table_transfer"):
-                        item = converter.transfer_table(
-                            ([f[lo:hi] for f in features], label[lo:hi]),
-                            nb, bs)
-                    if not put(("table", epoch, (item, nb))):
-                        return False
+            # ~(prefetch_size + 2) chunks on device at once. The per-chunk
+            # cap is re-read every chunk: a watchdog stall halves it (and,
+            # under the default "degrade" action, clears device_rebatch so
+            # the rest of this table — and every later table — moves
+            # per-batch instead of trusting the path that just wedged).
+            done = 0  # full batches already emitted from this table
+            while done < full_batches and converter.device_rebatch:
+                k = min(_MAX_CHUNK_BATCHES, converter.max_table_bytes
+                        // batch_bytes)
+                if k < 1:
+                    # Fat rows (a single batch exceeds the cap): per-batch
+                    # transfers bound device residency.
+                    break
+                nb = min(k, full_batches - done)
+                lo = offset + done * bs
+                hi = lo + nb * bs
+                with trace_span("table_transfer"):
+                    item = _supervised_transfer_table(
+                        converter,
+                        ([f[lo:hi] for f in features], label[lo:hi]),
+                        nb, bs, queue_depth)
+                if not put(("table", epoch, (item, nb))):
+                    return False
+                done += nb
+            for b in range(done, full_batches):
+                lo = offset + b * bs
+                with trace_span("batch_transfer"):
+                    batch = converter.transfer(
+                        ([f[lo:lo + bs] for f in features],
+                         label[lo:lo + bs]))
+                if not put(("batch", epoch, batch)):
+                    return False
             offset += full_batches * bs
         if offset < n:
             carry.append(([f[offset:] for f in features], label[offset:]))
@@ -633,6 +706,21 @@ class JaxShufflingDataset:
             derivation from ``max_device_input_bytes`` when set.
             Workloads where one batch alone exceeds the cap (fat rows —
             e.g. decoded images) fall back to per-batch transfers.
+        runtime_policy: explicit overrides for the runtime
+            health/degradation policy (``runtime/policy.py`` keys:
+            ``watchdog``, ``bulk_transfer_deadline_s``, ``stall_action``,
+            ``device_rebatch``, ...). Defaults resolve through
+            ``RSDL_JAX_DATASET_<KEY>`` / ``RSDL_<KEY>`` env vars, so
+            e.g. ``RSDL_DEVICE_REBATCH=0`` makes the per-batch path the
+            library default for ``device_rebatch="auto"`` constructions.
+            The bulk path runs under a progress watchdog: a chunk
+            ``device_put``/carve that misses ``bulk_transfer_deadline_s``
+            files a structured stall report into
+            ``stats.watchdog_stats()``, halves the in-flight bulk byte
+            cap, and — under the default ``stall_action="degrade"`` —
+            permanently drops this dataset to per-batch transfers with a
+            logged reason instead of hanging ("warn" records only,
+            "raise" fails the producer).
     """
 
     def __init__(self,
@@ -670,7 +758,8 @@ class JaxShufflingDataset:
                  spill_dir: Optional[str] = None,
                  device_rebatch="auto",
                  max_device_input_bytes: int = 1 << 30,
-                 max_device_table_bytes: Optional[int] = None):
+                 max_device_table_bytes: Optional[int] = None,
+                 runtime_policy: Optional[dict] = None):
         (self._feature_columns, self._feature_shapes, self._feature_types,
          self._label_column, self._label_shape, self._label_type) = (
              _normalize_jax_data_spec(feature_columns, feature_shapes,
@@ -698,6 +787,18 @@ class JaxShufflingDataset:
                                   if n == data_axis] or [1]))
             return batch_size % max(1, n_data) == 0
 
+        # Runtime health/degradation policy (runtime/policy.py): explicit
+        # runtime_policy kwargs > RSDL_JAX_DATASET_* env > RSDL_* env >
+        # library defaults. RSDL_DEVICE_REBATCH=0 — the old bench-only
+        # mitigation, promoted — makes the per-batch path the library
+        # default for every "auto" construction.
+        from ray_shuffling_data_loader_tpu.runtime import (policy as
+                                                           rt_policy)
+        self._runtime_policy = rt_policy.resolve_all(
+            "jax_dataset", **(runtime_policy or {}))
+        if (device_rebatch == "auto"
+                and self._runtime_policy["device_rebatch"] is False):
+            device_rebatch = False
         device_rebatch_auto = device_rebatch == "auto"
         if device_rebatch == "auto":
             # Bulk transfers need the persistent producer (the table path
@@ -747,13 +848,25 @@ class JaxShufflingDataset:
             # ~(prefetch_size + 2) chunks at once (ADVICE r3).
             max_device_table_bytes = max(
                 1, max_device_input_bytes // (self._prefetch_size + 2))
+        # The watchdog supervises only the bulk path (there is nothing to
+        # time out per-batch: each transfer is small and the consumer's
+        # queue.get is already interruptible via close()).
+        wd = None
+        if self._runtime_policy["watchdog"] and bool(device_rebatch):
+            from ray_shuffling_data_loader_tpu.runtime import (watchdog as
+                                                               rt_watchdog)
+            wd = rt_watchdog.get_watchdog()
         self._converter = _BatchConverter(
             self._feature_columns, self._feature_shapes, self._feature_types,
             self._label_column, self._label_shape, self._label_type,
             stack_features, mesh, data_axis, device_put,
             device_rebatch=bool(device_rebatch),
             device_rebatch_auto=device_rebatch_auto,
-            max_table_bytes=max_device_table_bytes)
+            max_table_bytes=max_device_table_bytes,
+            watchdog=wd,
+            bulk_transfer_deadline_s=(
+                self._runtime_policy["bulk_transfer_deadline_s"]),
+            stall_action=self._runtime_policy["stall_action"])
         self.batch_wait_stats = BatchWaitStats()
         # Persistent-prefetch state (one producer thread for ALL epochs).
         self._persistent = persistent_prefetch
@@ -925,17 +1038,36 @@ class JaxShufflingDataset:
                 if kind == "table":
                     # Bulk device table: carve batches on-device. Later
                     # batches of the same item record zero wait — accurate:
-                    # they are already in HBM.
+                    # they are already in HBM. The FIRST carve of each item
+                    # is watchdog-supervised (it dispatches the jitted
+                    # slicer — the carve half of the bulk path's liveness
+                    # contract); a deadline miss files a stall and, under
+                    # "degrade", stops the producer sending further bulk
+                    # items.
                     dev_table, n_batches = payload
                     start = 0
                     if self._consumer_skip:
                         start = min(self._consumer_skip, n_batches)
                         self._consumer_skip -= start
                     bs = self._dataset.batch_size
+                    wd = self._converter.watchdog
                     for b in range(start, n_batches):
                         if b > start:
                             self.batch_wait_stats.record(0.0)
-                        yield self._converter.slice_batch(dev_table, b, bs)
+                            batch = self._converter.slice_batch(
+                                dev_table, b, bs)
+                        elif wd is not None:
+                            with wd.watch(
+                                    "jax_dataset.bulk_carve",
+                                    deadline_s=(self._converter
+                                                .bulk_transfer_deadline_s),
+                                    on_stall=self._converter._on_bulk_stall):
+                                batch = self._converter.slice_batch(
+                                    dev_table, b, bs)
+                        else:
+                            batch = self._converter.slice_batch(
+                                dev_table, b, bs)
+                        yield batch
                     continue
                 if self._consumer_skip:
                     self._consumer_skip -= 1
@@ -951,6 +1083,12 @@ class JaxShufflingDataset:
             # guard above. A leftover skip must not eat the next epoch.
             self._consumer_skip = 0
             self._next_epoch = epoch + 1
+            # Break the wrapper->generator->frame->wrapper reference
+            # cycle: with it intact, a finished epoch's last device batch
+            # (held by this frame) is only released at a full cycle
+            # collection — the delayed-free class the release-event budget
+            # wait (runtime/release.py) exists to eliminate.
+            self._active_gen = None
 
     def close(self) -> None:
         """Stop the persistent producer and drop buffered device batches.

@@ -24,6 +24,18 @@ _lib: Optional[ctypes.CDLL] = None
 _load_attempted = False
 _load_lock = threading.Lock()
 
+
+def _notify_release() -> None:
+    """Wake budget waiters blocked on ledger releases (runtime/release.py).
+
+    Called on every last-ref decref and free-list trim — the ledger-side
+    half of the release-event channel that replaced the budget wait's
+    ``gc.collect()`` polling cadence. Lazy import: the runtime package
+    must stay importable without this module and vice versa.
+    """
+    from ray_shuffling_data_loader_tpu.runtime import release
+    release.notify_release()
+
 # Fixed so fill_random_* output is host-independent for a given seed; the
 # per-thread stream layout is a function of this value, not of cpu_count.
 _DEFAULT_FILL_THREADS = 8
@@ -276,6 +288,8 @@ class NativeBufferPool:
         count = lib.rsdl_buffer_decref(buf_id)
         if count < 0:
             raise KeyError(f"unknown buffer id {buf_id}")
+        if count == 0:
+            _notify_release()
         return count
 
     def bytes_in_use(self) -> int:
@@ -299,6 +313,7 @@ class NativeBufferPool:
         lib = _load()
         assert lib is not None
         lib.rsdl_buffer_trim_freelist()
+        _notify_release()
 
 
 class PythonBufferLedger:
@@ -356,7 +371,9 @@ class PythonBufferLedger:
             if entry[2] == 0:
                 del self._entries[buf_id]
                 self._bytes -= entry[1]
-            return entry[2]
+        if entry[2] == 0:
+            _notify_release()
+        return entry[2]
 
     def bytes_in_use(self) -> int:
         with self._lock:

@@ -1,0 +1,27 @@
+"""Runtime health & flow control: watchdogs, release events, policy.
+
+Three pillars, each importable on its own (all stdlib except the
+watchdog's stats hookup):
+
+- :mod:`.watchdog` — progress/deadline supervision for pipeline stages
+  (heartbeat registration, escalating stall reports into
+  ``stats.watchdog_stats()``); the bulk device-rebatch path uses it to
+  detect a wedged ``device_put`` and auto-degrade to per-batch
+  transfers instead of hanging.
+- :mod:`.release` — an explicit release-event channel on the native
+  buffer ledger (decref/trim -> condition notify) that replaced the
+  ``gc.collect()`` polling cadence in the shuffle's epoch-launch
+  budget wait.
+- :mod:`.policy` — the degradation-policy registry (env-var + kwargs
+  resolution) that turns bench-only mitigations like
+  ``RSDL_BENCH_DEVICE_REBATCH=0`` into library defaults
+  (``RSDL_DEVICE_REBATCH=0``) with per-component overrides.
+"""
+
+from ray_shuffling_data_loader_tpu.runtime import (  # noqa: F401
+    policy, release, watchdog)
+from ray_shuffling_data_loader_tpu.runtime.watchdog import (  # noqa: F401
+    StallReport, Watchdog, get_watchdog)
+
+__all__ = ["policy", "release", "watchdog", "StallReport", "Watchdog",
+           "get_watchdog"]

@@ -1,0 +1,134 @@
+"""Degradation-policy registry: one resolution surface for runtime knobs.
+
+The pipeline accumulated operational mitigations that lived only in the
+bench harness (``RSDL_BENCH_DEVICE_REBATCH=0`` to force the per-batch
+transfer path, ad-hoc timeouts in module constants). Production traffic
+needs those to be LIBRARY behavior: every runtime knob resolves through
+this module, with one precedence order everywhere::
+
+    explicit kwarg > RSDL_<COMPONENT>_<KEY> env > RSDL_<KEY> env
+                   > registered component default > library default
+
+Components are short names for the subsystem consulting the policy
+(``jax_dataset``, ``shuffle``, ``spill``, ``bench``). Example: a host
+whose device tunnel is known-flaky exports ``RSDL_DEVICE_REBATCH=0`` and
+every loader in every process degrades to per-batch transfers, while
+``RSDL_JAX_DATASET_BULK_TRANSFER_DEADLINE_S=5`` tightens only the
+loader's bulk-transfer watchdog.
+
+Stdlib-only on purpose: policy must be importable before (and without)
+jax/pyarrow, and from the native layer without cycles.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+_FALSE_WORDS = frozenset({"0", "false", "no", "off"})
+
+
+def _parse_bool(raw: str) -> bool:
+    return raw.strip().lower() not in _FALSE_WORDS
+
+
+def _parse_tristate(raw: str):
+    """``"auto"`` stays the string sentinel; anything else parses as bool."""
+    word = raw.strip().lower()
+    if word == "auto":
+        return "auto"
+    return _parse_bool(word)
+
+
+#: key -> (library default, parser for env-var strings). The parser also
+#: normalizes programmatic overrides where cheap (bools stay bools).
+_KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
+    # Bulk device-rebatch mode: "auto" / True / False. A False here turns
+    # the bench-only RSDL_BENCH_DEVICE_REBATCH=0 mitigation into the
+    # library default for every loader in the process.
+    "device_rebatch": ("auto", _parse_tristate),
+    # Progress watchdog over the bulk transfer/carve path.
+    "watchdog": (True, _parse_bool),
+    # Seconds a single bulk chunk device_put/carve may run before the
+    # watchdog declares a stall. Generous by default: a miss is meant to
+    # catch wedged transports, not slow ones.
+    "bulk_transfer_deadline_s": (30.0, float),
+    # What a stall does: "degrade" (drop to the per-batch path and keep
+    # going), "warn" (log + stats only), "raise" (fail the producer).
+    "stall_action": ("degrade", str),
+    # How long an epoch launch waits for consumers to release tables when
+    # over max_inflight_bytes before proceeding with a warning.
+    "budget_wait_timeout_s": (30.0, float),
+    # Upper bound between predicate re-checks in release-event waits — a
+    # safety heartbeat, not a polling cadence (releases wake waiters
+    # immediately).
+    "release_heartbeat_s": (0.25, float),
+    # Free-list trim cooldown under sustained budget pressure (spill.py).
+    "trim_cooldown_s": (1.0, float),
+    # Watchdog monitor thread poll interval.
+    "watchdog_poll_interval_s": (0.05, float),
+}
+
+_lock = threading.Lock()
+#: component -> {key -> default} registered by subsystems at import time.
+_component_defaults: Dict[str, Dict[str, Any]] = {}
+
+
+def register_defaults(component: str, **defaults: Any) -> None:
+    """Override library defaults for one component (kwargs surface for
+    embedding applications; env vars still win over these)."""
+    for key in defaults:
+        if key not in _KEYS:
+            raise ValueError(f"unknown policy key {key!r} "
+                             f"(known: {sorted(_KEYS)})")
+    with _lock:
+        _component_defaults.setdefault(component, {}).update(defaults)
+
+
+def _env_raw(component: str, key: str) -> Optional[str]:
+    for name in (f"RSDL_{component.upper()}_{key.upper()}",
+                 f"RSDL_{key.upper()}"):
+        raw = os.environ.get(name)
+        if raw is not None and raw.strip() != "":
+            return raw
+    return None
+
+
+def resolve(component: str, key: str, override: Any = None,
+            default: Any = None) -> Any:
+    """Resolve one policy key for a component (see module docstring for
+    the precedence order). ``override`` is the explicit-kwarg rung;
+    ``None`` means "not given". ``default`` replaces the LIBRARY default
+    (the lowest rung) — for call sites whose baseline lives in a module
+    constant that must stay patchable at runtime."""
+    if key not in _KEYS:
+        raise ValueError(f"unknown policy key {key!r} "
+                         f"(known: {sorted(_KEYS)})")
+    library_default, parser = _KEYS[key]
+    if override is not None:
+        return parser(override) if isinstance(override, str) else override
+    raw = _env_raw(component, key)
+    if raw is not None:
+        return parser(raw)
+    with _lock:
+        component_default = _component_defaults.get(component, {})
+        if key in component_default:
+            return component_default[key]
+    return library_default if default is None else default
+
+
+def resolve_all(component: str, **overrides: Any) -> Dict[str, Any]:
+    """Resolve every key for a component; ``overrides`` are explicit
+    kwargs (unknown keys raise, so typos fail loudly)."""
+    unknown = set(overrides) - set(_KEYS)
+    if unknown:
+        raise ValueError(f"unknown policy keys: {sorted(unknown)} "
+                         f"(known: {sorted(_KEYS)})")
+    return {key: resolve(component, key, overrides.get(key))
+            for key in _KEYS}
+
+
+def describe(component: str = "library") -> Dict[str, Any]:
+    """Resolved snapshot for diagnostics (bench JSON, bug reports)."""
+    return resolve_all(component)

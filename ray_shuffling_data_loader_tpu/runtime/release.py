@@ -1,0 +1,94 @@
+"""Event-driven release channel over the native buffer ledger.
+
+Plasma frees an object when its last ref-count drops and can wake a
+blocked producer at that instant (reference: shuffle.py:131-132 leans on
+exactly that). Our ledger decrefs fire from ``weakref.finalize`` when a
+table's Python wrapper is collected — but the epoch-launch budget wait
+used to OBSERVE those decrefs only by polling, with a periodic
+process-wide ``gc.collect()`` to flush wrappers stuck in reference
+cycles. That cadence cost up to ~1 s of launch latency per release and
+a full-heap cycle collection per second under sustained pressure.
+
+This module replaces the cadence with an explicit channel: the ledger
+wrappers (``native/__init__.py``) call :func:`notify_release` whenever
+an entry's bytes are returned (last decref, free-list trim), and budget
+waiters block in :func:`wait_while` — woken immediately by the release,
+re-checking their predicate, with only a coarse heartbeat as a safety
+net against release paths that bypass the ledger. The cycles that made
+``gc.collect()`` necessary are broken at their sources instead (the
+shuffle driver drops drained refs before waiting; the JAX binding
+unlinks its wrapper<->generator loop) — see the PR that introduced
+``runtime/``.
+
+Stdlib-only; importable from the native layer without cycles.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+# RLock, deliberately: notify_release is reached from weakref.finalize
+# callbacks, which the cycle collector may run at ANY allocation — even
+# one made inside notify_release by the thread already holding this
+# lock. A plain Lock would self-deadlock there; re-entry just bumps the
+# counter again.
+_cond = threading.Condition(threading.RLock())
+#: Monotonic count of release events since import. Waiters snapshot it,
+#: then block until it advances — no release is ever missed, even one
+#: that fires between the predicate check and the wait.
+_seq = 0
+
+
+def notify_release(count: int = 1) -> None:
+    """Record that ledger bytes were released and wake all waiters.
+
+    Called by the buffer-ledger wrappers on every last-ref decref and
+    free-list trim. Cheap (one lock round-trip per freed TABLE, not per
+    byte) and safe from any thread, including weakref finalizers.
+    """
+    global _seq
+    with _cond:
+        _seq += count
+        _cond.notify_all()
+
+
+def release_seq() -> int:
+    """Current value of the release counter (snapshot for waiters)."""
+    with _cond:
+        return _seq
+
+
+def wait_for_release(last_seen: int, timeout: float) -> int:
+    """Block until the release counter advances past ``last_seen`` or
+    ``timeout`` elapses; returns the counter's current value."""
+    deadline = time.monotonic() + timeout
+    with _cond:
+        while _seq == last_seen:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                break
+            _cond.wait(timeout=remaining)
+        return _seq
+
+
+def wait_while(predicate: Callable[[], bool], timeout_s: float,
+               heartbeat_s: float = 0.25) -> bool:
+    """Block while ``predicate()`` is True, re-evaluating on every
+    release event (and at least every ``heartbeat_s`` as a safety net).
+
+    Returns True if the predicate turned False within ``timeout_s``,
+    False on timeout. This is the epoch-launch budget wait's engine: a
+    consumer dropping its last reference to a table wakes the blocked
+    launch within the notify round-trip (~sub-millisecond), not at the
+    next poll tick.
+    """
+    deadline = time.monotonic() + timeout_s
+    seen = release_seq()
+    while predicate():
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return not predicate()
+        seen = wait_for_release(seen, timeout=min(heartbeat_s, remaining))
+    return True

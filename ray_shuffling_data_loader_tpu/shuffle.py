@@ -94,8 +94,12 @@ def derive_gather_threads(concurrent_reduces: int, pool_workers: int,
     concurrent = max(1, min(concurrent_reduces, pool_workers))
     return max(1, min(16, cores // concurrent))
 
-# How long shuffle() polls for consumers to release tables when
+# How long shuffle() waits for consumers to release tables when
 # max_inflight_bytes is exceeded before proceeding with a warning.
+# Policy-overridable (RSDL_SHUFFLE_BUDGET_WAIT_TIMEOUT_S / kwargs); this
+# constant is the library baseline. The wait itself is event-driven: the
+# buffer ledger wakes it on every release (runtime/release.py), not a
+# poll cadence.
 _BUDGET_POLL_TIMEOUT_S = 30.0
 
 
@@ -287,16 +291,26 @@ class DiskTableCache:
             except OSError:
                 pass
             return False
+        # Charge the REAL on-disk size against the budget, not
+        # table.nbytes: IPC framing, schema/footer metadata, and 8/64-byte
+        # alignment padding make the file larger than the raw column bytes
+        # (ADVICE r5 — the drift compounds over thousands of files and let
+        # the cache overshoot its disk budget).
+        try:
+            disk_bytes = _os.stat(path).st_size
+        except OSError:
+            disk_bytes = nbytes  # keep the reservation if stat fails
         with self._lock:
             self._inflight.discard(key)
+            self._bytes += disk_bytes - nbytes  # re-charge at actual size
             if self._closed:  # closed while writing: drop the orphan
-                self._bytes -= nbytes
+                self._bytes -= disk_bytes
                 try:
                     _os.remove(path)
                 except OSError:
                     pass
                 return False
-            self._paths[key] = (path, nbytes)
+            self._paths[key] = (path, disk_bytes)
         return True
 
     @property
@@ -771,12 +785,14 @@ def shuffle(filenames: Sequence[str],
     ``max_inflight_bytes`` bounds TRANSIENT pipeline memory (in-flight map
     and reducer tables as accounted by the buffer ledger, file-cache bytes
     excluded): before launching a new epoch, waits — first by draining
-    older epochs, then by polling for consumers to release tables — until
-    under budget. The explicit analog of the reference operators sizing the
-    plasma store and disabling spill (reference: benchmarks/cluster.yaml:175).
-    The budget must exceed one epoch's working set; if consumers do not
-    release within ``_BUDGET_POLL_TIMEOUT_S`` the launch proceeds with a
-    warning rather than deadlocking.
+    older epochs, then blocked on ledger release events (every consumer
+    table release wakes the wait, runtime/release.py) — until under
+    budget. The explicit analog of the reference operators sizing the
+    plasma store and disabling spill (reference: benchmarks/cluster.yaml:175),
+    with plasma's release-wakes-producer semantics. The budget must exceed
+    one epoch's working set; if consumers do not release within
+    ``_BUDGET_POLL_TIMEOUT_S`` (policy key ``budget_wait_timeout_s``) the
+    launch proceeds with a warning rather than deadlocking.
 
     ``spill_dir`` (with ``max_inflight_bytes``) enables plasma's spill
     role: reducer outputs produced while over budget are written to Arrow
@@ -835,33 +851,32 @@ def shuffle(filenames: Sequence[str],
                 for ref in refs:
                     ref.result()  # propagate map/reduce failures (instant)
                 # Refs dropped here -> reducer Tables release once trainers
-                # finish with them (reference: shuffle.py:131-132).
+                # finish with them (reference: shuffle.py:131-132). The
+                # frame's loop variables would otherwise pin the drained
+                # epoch's last reducer table through the budget wait below.
+                refs = ref = None
             if _over_budget() and spill_manager is None:
                 # All prior epochs drained; wait for consumers to release
                 # tables (bounded — never deadlock the pipeline on a
                 # too-small budget). With a spill manager the launch
                 # proceeds instead: over-budget reducer outputs go to disk.
-                import gc
-                import time as _time
-                deadline = timeit.default_timer() + _BUDGET_POLL_TIMEOUT_S
-                next_gc = 0.0  # collect now, then every ~1s: tables freed
-                # through reference cycles only decref the ledger at a
-                # cycle collection.
-                while _over_budget():
-                    now = timeit.default_timer()
-                    if now >= next_gc:
-                        gc.collect()
-                        next_gc = now + 1.0
-                        if not _over_budget():
-                            break
-                    if now >= deadline:
-                        logger.warning(
-                            "epoch %d launching over max_inflight_bytes=%d "
-                            "(consumers did not release within %.0fs)",
-                            epoch_idx, max_inflight_bytes,
-                            _BUDGET_POLL_TIMEOUT_S)
-                        break
-                    _time.sleep(0.02)
+                # Event-driven: every last-ref ledger decref (and free-list
+                # trim) wakes this wait immediately (runtime/release.py) —
+                # plasma's release semantics, replacing the old periodic
+                # process-wide gc.collect() cadence.
+                from ray_shuffling_data_loader_tpu.runtime import (
+                    policy as rt_policy, release as rt_release)
+                timeout_s = rt_policy.resolve(
+                    "shuffle", "budget_wait_timeout_s",
+                    default=_BUDGET_POLL_TIMEOUT_S)
+                if not rt_release.wait_while(
+                        _over_budget, timeout_s=timeout_s,
+                        heartbeat_s=rt_policy.resolve(
+                            "shuffle", "release_heartbeat_s")):
+                    logger.warning(
+                        "epoch %d launching over max_inflight_bytes=%d "
+                        "(consumers did not release within %.0fs)",
+                        epoch_idx, max_inflight_bytes, timeout_s)
             throttle_duration = timeit.default_timer() - throttle_start
             if stats_collector is not None and throttle_duration > 1e-4:
                 stats_collector.throttle_done(epoch_idx, throttle_duration)

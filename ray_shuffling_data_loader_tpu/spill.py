@@ -187,7 +187,10 @@ def make_budget_state(file_cache, max_inflight_bytes: Optional[int],
     # pipelines' included) and re-paying mmap + first-touch faults on every
     # recv defeats recycling, so under SUSTAINED budget pressure trim at
     # most once per cooldown window instead of on every over-budget probe.
-    _TRIM_COOLDOWN_S = 1.0
+    # (A trim that does fire notifies runtime.release, so other budget
+    # waiters re-check immediately.)
+    from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
+    trim_cooldown_s = rt_policy.resolve("spill", "trim_cooldown_s")
     last_trim = [float("-inf")]
 
     def over_budget() -> bool:
@@ -206,7 +209,7 @@ def make_budget_state(file_cache, max_inflight_bytes: Optional[int],
             return False
         now = time.monotonic()
         if (ledger.freelist_bytes()
-                and now - last_trim[0] >= _TRIM_COOLDOWN_S):
+                and now - last_trim[0] >= trim_cooldown_s):
             last_trim[0] = now
             ledger.trim_freelist()
             return transient() > max_inflight_bytes

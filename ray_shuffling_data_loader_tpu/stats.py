@@ -9,7 +9,10 @@ host threads here, so a lock replaces the actor mailbox).
 
 Report schema: the trial CSV and epoch CSV column sets reproduce the
 reference's exactly (reference: stats.py:305-355,468-505) so downstream
-tooling reads either. Memory utilization sampling replaces the raylet gRPC
+tooling reads either; the trial CSV additionally APPENDS the
+watchdog/stall columns (``watchdog_events``, ``stall_escalations``,
+``fallbacks_engaged`` — process totals at write time), which
+position-indexed reference tooling never sees. Memory utilization sampling replaces the raylet gRPC
 store probe (reference: stats.py:598-632) with host RSS + native buffer-pool
 bytes + optional TPU HBM via ``device.memory_stats()``.
 """
@@ -329,6 +332,83 @@ class BatchWaitStats:
 
 
 # ---------------------------------------------------------------------------
+# Watchdog / stall reporting (runtime/watchdog.py files structured reports
+# here; the bench harness and the CSV writers read the process totals)
+# ---------------------------------------------------------------------------
+
+
+class WatchdogStats:
+    """Process-wide sink for structured stall reports and degradation
+    decisions.
+
+    ``runtime.watchdog`` records every deadline miss (escalation 1 = the
+    first miss of a watch, 2+ = the stall persisting across further
+    deadline multiples); subsystems record each automatic degradation
+    (e.g. the bulk-transfer path dropping to per-batch). Totals are
+    monotonic — snapshot before/after a run to measure that run's
+    events, the same protocol as ``spill.process_spill_totals``.
+    """
+
+    _RECENT = 32  # ring of most recent stalls kept for diagnostics
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._events = 0          # every recorded deadline miss
+        self._escalations = 0     # misses beyond a watch's first
+        self._fallbacks = 0       # automatic degradations engaged
+        self._by_name: Dict[str, int] = {}
+        self._recent: List[Dict[str, Any]] = []
+
+    def record_stall(self, report) -> None:
+        """``report`` is a ``runtime.watchdog.StallReport`` (duck-typed:
+        name/waited_s/deadline_s/escalation/detail/timestamp)."""
+        entry = {
+            "name": report.name,
+            "waited_s": float(report.waited_s),
+            "deadline_s": float(report.deadline_s),
+            "escalation": int(report.escalation),
+            "detail": report.detail,
+            "timestamp": float(report.timestamp),
+        }
+        with self._lock:
+            self._events += 1
+            if report.escalation > 1:
+                self._escalations += 1
+            self._by_name[report.name] = (
+                self._by_name.get(report.name, 0) + 1)
+            self._recent.append(entry)
+            del self._recent[:-self._RECENT]
+
+    def record_fallback(self, component: str, reason: str) -> None:
+        with self._lock:
+            self._fallbacks += 1
+            self._recent.append({
+                "name": f"{component}:fallback",
+                "detail": reason,
+                "timestamp": time.time(),
+            })
+            del self._recent[:-self._RECENT]
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "watchdog_events": self._events,
+                "stall_escalations": self._escalations,
+                "fallbacks_engaged": self._fallbacks,
+                "stalls_by_name": dict(self._by_name),
+                "recent_stalls": list(self._recent),
+            }
+
+
+_watchdog_stats = WatchdogStats()
+
+
+def watchdog_stats() -> WatchdogStats:
+    """THE process-wide watchdog/stall recorder."""
+    return _watchdog_stats
+
+
+# ---------------------------------------------------------------------------
 # Memory utilization sampler (reference: stats.py:598-648, raylet gRPC ->
 # host/pool/HBM introspection)
 # ---------------------------------------------------------------------------
@@ -431,6 +511,8 @@ TRIAL_FIELDNAMES = [
     "max_consume_task_duration", "min_consume_task_duration",
     "avg_time_to_consume", "std_time_to_consume", "max_time_to_consume",
     "min_time_to_consume",
+    # Appended past the reference's column set (see module docstring).
+    "watchdog_events", "stall_escalations", "fallbacks_engaged",
 ]
 
 EPOCH_FIELDNAMES = [
@@ -509,6 +591,8 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
         "max_concurrent_epochs": max_concurrent_epochs,
     }
 
+    wd = watchdog_stats().snapshot()
+
     path, header = _open_report("trial")
     logger.info("Writing trial stats to %s", path)
     with fileio.open_text(path, write_mode) as f:
@@ -518,6 +602,9 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
         for trial, (stats, trial_ss) in enumerate(all_stats):
             row: Dict[str, Any] = dict(static)
             row["trial"] = trial
+            row["watchdog_events"] = wd["watchdog_events"]
+            row["stall_escalations"] = wd["stall_escalations"]
+            row["fallbacks_engaged"] = wd["fallbacks_engaged"]
             row["duration"] = stats.duration
             row_tp = num_epochs * num_rows / stats.duration
             row["row_throughput"] = row_tp

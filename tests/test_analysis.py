@@ -219,6 +219,26 @@ SWALLOWED_OK = """
             raise
 """
 
+GC_WAIT_BAD = """
+    import gc
+    import time
+
+    def wait_for_budget(over_budget, deadline):
+        while over_budget():
+            gc.collect()  # flush cycle-stuck frees every poll tick
+            if time.monotonic() >= deadline:
+                break
+            time.sleep(0.02)
+"""
+
+GC_WAIT_OK = """
+    import gc
+
+    def wait_for_budget(over_budget, timeout_s, release):
+        gc.collect()  # one-off, outside any wait loop: not flagged
+        return release.wait_while(over_budget, timeout_s=timeout_s)
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -231,6 +251,7 @@ CASES = [
     ("arrow-concat-promote", CONCAT_BAD, CONCAT_OK, {}),
     ("arrow-zero-copy", ZERO_COPY_BAD, ZERO_COPY_OK, {}),
     ("swallowed-exception", SWALLOWED_BAD, SWALLOWED_OK, {}),
+    ("gc-collect-in-wait", GC_WAIT_BAD, GC_WAIT_OK, {}),
 ]
 
 

@@ -280,11 +280,36 @@ def test_disk_cache_concurrent_same_key_puts_single_writer(tmp_path):
         t.join(timeout=30)
         assert not t.is_alive()
     assert results.count(True) >= 1
-    # Budget charged exactly once regardless of how many writers raced.
-    assert cache.disk_bytes == table.nbytes
+    # Budget charged exactly once regardless of how many writers raced —
+    # at the REAL on-disk size (see test_disk_cache_charges_on_disk_size).
+    (ipc_path,) = [p for p in (tmp_path / "dcache").iterdir()
+                   if p.suffix == ".arrow"]
+    assert cache.disk_bytes == ipc_path.stat().st_size
     hit = cache.get(filenames[0])
     assert hit is not None and hit.equals(table)
     cache.close()
+
+
+def test_disk_cache_charges_on_disk_size(tmp_path):
+    """The budget must see what the filesystem sees: the Arrow IPC file
+    (framing + schema/footer metadata + alignment padding), not the raw
+    ``table.nbytes`` (ADVICE r5 — the drift compounds over thousands of
+    cached files and overshoots the disk budget)."""
+    import os
+
+    filenames = write_numeric_files(tmp_path, num_files=1)
+    table = sh.fileio.read_parquet(filenames[0]).combine_chunks()
+    cache = sh.DiskTableCache(max_bytes=1 << 30,
+                              cache_dir=str(tmp_path / "dcache"))
+    assert cache.put(filenames[0], table)
+    (ipc_path,) = [p for p in (tmp_path / "dcache").iterdir()
+                   if p.suffix == ".arrow"]
+    real = os.stat(ipc_path).st_size
+    assert cache.disk_bytes == real
+    assert real > table.nbytes  # the framing overhead being accounted
+    # close() uncharges the real size, back to zero.
+    cache.close()
+    assert cache.disk_bytes == 0
 
 
 def test_disk_cache_corrupt_file_degrades_to_redecode(tmp_path):
