@@ -60,7 +60,21 @@ instead of per-micro-step dispatch — see the note in run_train),
 RSDL_BENCH_DEVICE_TABLE_BYTES (bulk-path per-chunk transfer cap),
 RSDL_BENCH_RUNS (train-phase repeats for the median-of-N contract
 fields + congestion marker; default 3 on accelerators, 1 under
-RSDL_BENCH_CPU). The JSON also carries runtime-health evidence
+RSDL_BENCH_CPU).
+
+Chaos soak mode: ``--chaos[=RATE]`` argv flag (or RSDL_BENCH_CHAOS_RATE)
+installs a seeded fault-rate spec over the recoverable sites
+(``map_read`` / ``reduce_gather`` / ``device_transfer`` /
+``spill_write``, runtime/faults.py) for the whole invocation: ~RATE of
+each site's task keys fail once and must be recovered (lineage
+recompute / in-task retry / spill degrade). The run must still complete
+every selected phase — a phase that dies under chaos exits non-zero —
+and the JSON gains the fault_stats() delta (``faults_injected``,
+``fault_retries``, ``fault_recomputes``, ``fault_quarantines``,
+``fault_recoveries_exhausted``, ``chaos_rate``). An explicit
+RSDL_CHAOS_SPEC wins over the rate spec (targeted reproduction:
+``RSDL_CHAOS_SPEC="map_read:epoch1:file2"`` fails the same way every
+run). The JSON also carries runtime-health evidence
 (``watchdog_events``, ``stall_escalations``, ``fallback_engaged``) from
 the bulk-path progress watchdog, and the library degradation policy
 (runtime/policy.py) now owns the device-rebatch default:
@@ -664,6 +678,40 @@ def run_train(jax, filenames, *, num_epochs, batch_size, num_reducers,
     }
 
 
+def _chaos_rate_from_invocation() -> "float | None":
+    """``--chaos`` / ``--chaos=RATE`` argv flag or RSDL_BENCH_CHAOS_RATE."""
+    rate = None
+    for arg in sys.argv[1:]:
+        if arg == "--chaos":
+            rate = float(os.environ.get("RSDL_BENCH_CHAOS_RATE", "0.05"))
+        elif arg.startswith("--chaos="):
+            rate = float(arg.split("=", 1)[1])
+    if rate is None and os.environ.get("RSDL_BENCH_CHAOS_RATE"):
+        rate = float(os.environ["RSDL_BENCH_CHAOS_RATE"])
+    return rate
+
+
+def _install_chaos(rate: "float | None") -> "float | None":
+    """Activate the soak spec unless a targeted RSDL_CHAOS_SPEC is set
+    (the env spec was already honored at library import)."""
+    from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+    if os.environ.get("RSDL_CHAOS_SPEC", "").strip() \
+            or os.environ.get("RSDL_FAULTS_SPEC", "").strip():
+        print("# chaos: honoring RSDL_CHAOS_SPEC over --chaos rate",
+              file=sys.stderr)
+        return rate
+    if rate is None:
+        return None
+    seed = int(os.environ.get("RSDL_CHAOS_SEED", "0"))
+    spec = ",".join(f"{site}@{rate}" for site in
+                    ("map_read", "reduce_gather", "device_transfer",
+                     "spill_write"))
+    rt_faults.install(spec, seed=seed)
+    print(f"# chaos soak: rate={rate} seed={seed} over recoverable sites",
+          file=sys.stderr)
+    return rate
+
+
 def main() -> None:
     if os.environ.get("RSDL_BENCH_CPU"):
         os.environ.setdefault(
@@ -786,6 +834,8 @@ def main() -> None:
     # Watchdog/stall totals are monotonic process counters; the JSON
     # reports this invocation's delta.
     wd_before = rsdl_stats.watchdog_stats().snapshot()
+    chaos_rate = _install_chaos(_chaos_rate_from_invocation())
+    fs_before = rsdl_stats.fault_stats().snapshot()
 
     cached = cold = train = train_agg = None
 
@@ -981,6 +1031,22 @@ def main() -> None:
                                    - wd_before["stall_escalations"])
     record["fallback_engaged"] = (wd_after["fallbacks_engaged"]
                                   > wd_before["fallbacks_engaged"])
+    # Fault/recovery evidence (runtime/faults.py + runtime/retry.py):
+    # this invocation's delta of the process fault counters. Reported
+    # whenever anything fired (an env chaos spec counts), always under
+    # --chaos soak.
+    fs_after = rsdl_stats.fault_stats().snapshot()
+    fs_delta = {key: fs_after[key] - fs_before[key] for key in
+                ("injected", "retries", "recomputes", "quarantines",
+                 "exhausted")}
+    if chaos_rate is not None or any(fs_delta.values()):
+        record["faults_injected"] = fs_delta["injected"]
+        record["fault_retries"] = fs_delta["retries"]
+        record["fault_recomputes"] = fs_delta["recomputes"]
+        record["fault_quarantines"] = fs_delta["quarantines"]
+        record["fault_recoveries_exhausted"] = fs_delta["exhausted"]
+    if chaos_rate is not None:
+        record["chaos_rate"] = chaos_rate
     if cold is not None:
         # "disk": parquet decoded ONCE inside the timed window, later
         # epochs stream from mmap'd Arrow IPC scratch (fresh dir per
@@ -1041,6 +1107,21 @@ def main() -> None:
             record.update(train_agg)
 
     print(json.dumps(record))
+
+    if chaos_rate is not None:
+        # The soak contract: injected faults are RECOVERED, not survived
+        # by luck — every selected phase must still complete.
+        missing = [name for name, result in
+                   (("cached", cached), ("cold", cold), ("train", train))
+                   if name in phases and result is None]
+        if missing:
+            print(f"# chaos soak FAILED: phase(s) {missing} did not "
+                  f"complete under fault rate {chaos_rate}",
+                  file=sys.stderr)
+            sys.exit(1)
+        print(f"# chaos soak OK: {fs_delta['injected']} injected, "
+              f"{fs_delta['recomputes']} recomputed, "
+              f"{fs_delta['exhausted']} exhausted", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -8,6 +8,15 @@ and a ``gc.collect()`` inside a wait/poll loop is both a symptom (some
 path still leaks frees through reference cycles instead of breaking
 them) and a cost (a full-heap cycle collection per poll tick,
 process-wide, while holding up the very pipeline it's trying to help).
+
+Same story for ad-hoc retry loops (``unbounded-retry``): the repo
+accumulated four independent retry idioms before ``runtime/retry.py``
+unified them; a ``while True`` retry loop has no attempt budget and no
+deadline (a permanently-failing resource hangs the pipeline forever),
+and a fixed-interval ``time.sleep(N)`` retry re-hits a recovering
+resource in lockstep with every other retrier. Both shapes must route
+through :class:`runtime.retry.RetryPolicy` (bounded attempts,
+decorrelated jitter, deadline, fault-stats accounting).
 """
 
 from __future__ import annotations
@@ -86,3 +95,69 @@ class GcCollectInWaitRule(Rule):
                         "tick; releases are event-driven — wake on "
                         "runtime.release events and break the reference "
                         "cycle that delays the free at its source")
+
+
+def _is_while_true(loop: ast.AST) -> bool:
+    return (isinstance(loop, ast.While)
+            and isinstance(loop.test, ast.Constant)
+            and loop.test.value is True)
+
+
+def _sleep_calls(loop: ast.AST):
+    for node in ast.walk(loop):
+        if isinstance(node, ast.Call) \
+                and dotted_name(node.func).rsplit(".", 1)[-1] == "sleep":
+            yield node
+
+
+def _is_retry_loop(loop: ast.AST) -> bool:
+    """A loop whose body is a try whose except handler STAYS in the loop
+    (re-attempting the failed work). A handler that exits — ``return``,
+    ``raise``, ``break`` — is failure propagation, not a retry; a try
+    buried inside nested statements is stream processing (e.g. a monitor
+    servicing many watches), not a retried operation."""
+    for stmt in loop.body:
+        if not isinstance(stmt, ast.Try):
+            continue
+        for handler in stmt.handlers:
+            last = handler.body[-1] if handler.body else None
+            if not isinstance(last, (ast.Return, ast.Raise, ast.Break)):
+                return True
+    return False
+
+
+@register
+class UnboundedRetryRule(Rule):
+    id = "unbounded-retry"
+    category = "runtime"
+    description = ("`while True` retry loops and fixed-interval "
+                   "`time.sleep(N)` retry loops — retries must route "
+                   "through runtime.retry.RetryPolicy (bounded attempts, "
+                   "decorrelated jitter, deadline)")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        # RetryPolicy's own engine is the one sanctioned retry loop.
+        if ctx.path.endswith("runtime/retry.py"):
+            return
+        for loop in ast.walk(tree):
+            if not isinstance(loop, (ast.While, ast.For, ast.AsyncFor)):
+                continue
+            if not _is_retry_loop(loop):
+                continue  # no failure absorbed in-loop: not a retry
+            if _is_while_true(loop):
+                yield ctx.violation(
+                    self, loop,
+                    "`while True` retry loop has no attempt budget or "
+                    "deadline — a permanently-failing resource hangs here "
+                    "forever; route the call through "
+                    "runtime.retry.RetryPolicy")
+                continue
+            for call in _sleep_calls(loop):
+                if call.args and isinstance(call.args[0], ast.Constant):
+                    yield ctx.violation(
+                        self, call,
+                        "fixed-interval sleep in a retry loop re-hits a "
+                        "recovering resource in lockstep with every other "
+                        "retrier; use runtime.retry.RetryPolicy "
+                        "(exponential backoff with decorrelated jitter)")

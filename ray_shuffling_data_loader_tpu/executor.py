@@ -112,7 +112,8 @@ class Executor:
 
     def __init__(self, num_workers: Optional[int] = None,
                  thread_name_prefix: str = "rsdl-worker",
-                 task_retries: int = 0):
+                 task_retries: int = 0,
+                 retry_policy=None):
         """``task_retries``: re-run a task that raises up to N extra times
         before surfacing the failure — the stand-in for Ray's implicit task
         retry the reference leans on (SURVEY.md §5). Safe for local shuffle
@@ -121,13 +122,25 @@ class Executor:
         tasks (re-sent chunks are deduplicated by the receiver). NOT safe
         for tasks that consume one-shot inputs — distributed REDUCE tasks
         consume transport messages exactly once, so they are submitted via
-        :meth:`submit_once`."""
+        :meth:`submit_once`.
+
+        Retries run under the shared ``runtime.retry.RetryPolicy``
+        (exponential backoff with decorrelated jitter — a zero-sleep loop
+        hammers exactly the resource that just failed), resolved for the
+        ``executor`` component (``RSDL_EXECUTOR_RETRY_*`` env overrides
+        the backoff bounds; ``task_retries`` pins the attempt budget).
+        Pass ``retry_policy`` to override wholesale."""
         if num_workers is None:
             num_workers = os.cpu_count() or 4
         if task_retries < 0:
             raise ValueError(f"task_retries must be >= 0, got {task_retries}")
         self._num_workers = num_workers
         self._task_retries = task_retries
+        if retry_policy is None and task_retries:
+            from ray_shuffling_data_loader_tpu.runtime import retry as rt
+            retry_policy = rt.RetryPolicy.for_component(
+                "executor", retry_max_attempts=task_retries + 1)
+        self._retry_policy = retry_policy
         self._pool = cf.ThreadPoolExecutor(
             max_workers=num_workers, thread_name_prefix=thread_name_prefix)
         self._shutdown = False
@@ -139,7 +152,7 @@ class Executor:
     def submit(self, fn: Callable, *args, **kwargs) -> TaskRef:
         if self._shutdown:
             raise RuntimeError("executor is shut down")
-        if self._task_retries:
+        if self._retry_policy is not None:
             return TaskRef(self._pool.submit(self._run_with_retries, fn,
                                              args, kwargs))
         return TaskRef(self._pool.submit(fn, *args, **kwargs))
@@ -154,16 +167,13 @@ class Executor:
         return TaskRef(self._pool.submit(fn, *args, **kwargs))
 
     def _run_with_retries(self, fn: Callable, args, kwargs) -> Any:
-        for attempt in range(self._task_retries + 1):
-            try:
-                return fn(*args, **kwargs)
-            except Exception as e:
-                if attempt == self._task_retries:
-                    raise
-                logger.warning(
-                    "task %s failed (attempt %d/%d): %s; retrying",
-                    getattr(fn, "__name__", fn), attempt + 1,
-                    self._task_retries + 1, e)
+        # RetryPolicy owns the loop: jittered backoff between attempts
+        # (never a zero-sleep hammer), the teardown-signal exclusions
+        # (KeyboardInterrupt/SystemExit are never swallowed and never
+        # retried), WARNING per intermediate failure, and the FINAL
+        # failure logged at ERROR with the exhausted budget.
+        return self._retry_policy.call(
+            fn, *args, describe=getattr(fn, "__name__", repr(fn)), **kwargs)
 
     def map(self, fn: Callable, items: Sequence) -> List[TaskRef]:
         return [self.submit(fn, item) for item in items]

@@ -34,6 +34,8 @@ import pyarrow as pa
 
 from ray_shuffling_data_loader_tpu.dataset import (ShufflingDataset,
                                                    slice_batches)
+from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
 from ray_shuffling_data_loader_tpu.stats import BatchWaitStats
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
@@ -246,6 +248,33 @@ class _BatchConverter:
         self.stall_action = stall_action
         self.fallback_engaged = False  # a stall degraded the bulk path
         self._slicer = {}  # batch_size -> jitted batch slicer, built lazily
+        # Transient device-transfer failures (tunnel hiccup, injected
+        # `device_transfer` fault) are retried in place: the source arrays
+        # are host-resident numpy, so a re-put is pure. Predicate is
+        # IO-shaped only — a shape/dtype error is a bug and surfaces.
+        self._transfer_retry = rt_retry.RetryPolicy.for_component(
+            "jax_dataset", retryable=rt_retry.transient_retryable)
+        self._transfer_seq = 0  # producer-thread-only; keys chaos draws
+
+    def _device_put_retried(self, thunk):
+        """One (bulk or per-batch) device_put: named fault site + bounded
+        retry; a recovery after failure is recorded in fault_stats. The
+        per-converter transfer sequence keys the chaos site, so a rate
+        rule (``device_transfer@0.02``) draws independently per transfer
+        and a targeted rule can hit exactly one."""
+
+        def _put():
+            self._transfer_seq += 1
+            rt_faults.inject("device_transfer", task=self._transfer_seq)
+            return thunk()
+
+        def _recovered(failed_attempts: int, elapsed_s: float) -> None:
+            from ray_shuffling_data_loader_tpu import stats as stats_mod
+            stats_mod.fault_stats().record_recompute(
+                "device_transfer", elapsed_s)
+
+        return self._transfer_retry.call(_put, describe="device_put",
+                                         on_recovery=_recovered)
 
     def _on_bulk_stall(self, report) -> None:
         """Watchdog escalation hook — runs on the MONITOR thread (the
@@ -301,12 +330,14 @@ class _BatchConverter:
         # client once, not once per column — on a tunneled device that is
         # the difference between 1 and 20 round-trips per batch).
         if self._mesh is None:
-            out_features, out_label = jax.device_put((features, label))
+            out_features, out_label = self._device_put_retried(
+                lambda: jax.device_put((features, label)))
         else:
-            out_features, out_label = jax.device_put(
-                (features, label),
-                ([self._sharding(a.ndim) for a in features],
-                 self._sharding(label.ndim)))
+            out_features, out_label = self._device_put_retried(
+                lambda: jax.device_put(
+                    (features, label),
+                    ([self._sharding(a.ndim) for a in features],
+                     self._sharding(label.ndim))))
         if self._stack_features:
             if len(out_features) == 1:
                 out_features = out_features[0]
@@ -338,7 +369,8 @@ class _BatchConverter:
         if not self._device_put:
             return features, label
         if self._mesh is None:
-            return jax.device_put((features, label))
+            return self._device_put_retried(
+                lambda: jax.device_put((features, label)))
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         def chunked(a):
@@ -351,9 +383,10 @@ class _BatchConverter:
 
         features = [chunked(f) for f in features]
         label = chunked(label)
-        return jax.device_put(
-            (features, label),
-            ([sharding(f) for f in features], sharding(label)))
+        return self._device_put_retried(
+            lambda: jax.device_put(
+                (features, label),
+                ([sharding(f) for f in features], sharding(label))))
 
     def slice_batch(self, dev_table, batch_index: int, batch_size: int):
         """Carve batch ``batch_index`` out of a bulk device chunk: one

@@ -27,6 +27,7 @@ import threading
 import time
 from typing import Any, List, Optional
 
+from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
@@ -223,6 +224,9 @@ class MultiQueue:
     def put(self, queue_index: int, item: Any, block: bool = True,
             timeout: Optional[float] = None) -> None:
         """Put one item (reference: multiqueue.py:98-125)."""
+        # Fault site: fires before the enqueue, so an injected put fault
+        # never half-delivers an item (chaos keyed by queue index).
+        rt_faults.inject("queue_put", task=queue_index)
         self._check_open()
         try:
             self._queues[queue_index].put(item, block=block, timeout=timeout)
@@ -266,6 +270,9 @@ class MultiQueue:
     def get(self, queue_index: int, block: bool = True,
             timeout: Optional[float] = None) -> Any:
         """Pop one item (reference: multiqueue.py:185-214)."""
+        # Fault site: fires before the dequeue — no item is consumed, so
+        # the caller may retry (or crash, for checkpoint-resume chaos).
+        rt_faults.inject("queue_get", task=queue_index)
         try:
             return self._queues[queue_index].get(block=block, timeout=timeout)
         except Empty:

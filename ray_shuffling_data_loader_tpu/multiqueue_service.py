@@ -40,13 +40,14 @@ import concurrent.futures as cf
 import socket
 import struct
 import threading
-import time
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Tuple
 
 import pyarrow as pa
 
 from ray_shuffling_data_loader_tpu import multiqueue as mq
 from ray_shuffling_data_loader_tpu.dataset import ShuffleFailure
+from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
@@ -227,25 +228,20 @@ class RemoteQueue:
                  initial_backoff_s: float = mq.CONNECT_INITIAL_BACKOFF_S,
                  max_batch: int = DEFAULT_MAX_BATCH,
                  prefetch: bool = True):
-        last_err: Optional[Exception] = None
-        backoff = initial_backoff_s
-        for attempt in range(retries + 1):
-            try:
-                self._sock = socket.create_connection(address, timeout=30)
-                self._sock.settimeout(None)
-                self._sock.setsockopt(socket.IPPROTO_TCP,
-                                      socket.TCP_NODELAY, 1)
-                last_err = None
-                break
-            except OSError as e:
-                last_err = e
-                if attempt < retries:
-                    time.sleep(backoff)
-                    backoff *= 2
-        if last_err is not None:
+        self._address = address
+        # One RetryPolicy for connect AND mid-stream refetch: jittered
+        # doubling backoff (many trainer processes dialing one server
+        # de-synchronize), attempts pinned by the caller's budget.
+        self._retry = rt_retry.RetryPolicy.for_component(
+            "queue", retry_max_attempts=retries + 1,
+            retry_initial_backoff_s=initial_backoff_s,
+            retryable=rt_retry.transient_retryable)
+        try:
+            self._retry.call(self._reconnect, describe=f"connect {address}")
+        except OSError as e:
             raise ConnectionError(
                 f"could not reach queue server at {address} after "
-                f"{retries + 1} attempts: {last_err}")
+                f"{retries + 1} attempts: {e}")
         self._max_batch = max(1, max_batch)
         self._prefetch = prefetch
         self._io_lock = threading.Lock()      # serializes wire round trips
@@ -257,21 +253,68 @@ class RemoteQueue:
         self._io = cf.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="rsdl-rqueue-prefetch")
 
+    def _reconnect(self) -> None:
+        """(Re-)dial the queue server; the old socket (if any) is closed
+        first so a half-dead connection cannot leak."""
+        old = getattr(self, "_sock", None)
+        if old is not None:
+            try:
+                old.close()
+            except OSError:
+                pass
+        sock = socket.create_connection(self._address, timeout=30)
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        self._sock = sock
+
     def _fetch_batch(self, queue_index: int) -> List:
         """One wire round trip: request up to ``max_batch`` items and
         decode the response frames. Runs on the caller's thread or the
-        prefetcher; ``_io_lock`` keeps round trips whole."""
-        with self._io_lock:
-            self._sock.sendall(
-                _REQUEST.pack(OP_GET_BATCH, queue_index, self._max_batch))
-            (count,) = _BATCH_HEADER.unpack(
-                _recv_exact(self._sock, _BATCH_HEADER.size))
-            frames = []
-            for _ in range(count):
-                kind, length = _FRAME.unpack(
-                    _recv_exact(self._sock, _FRAME.size))
-                payload = _recv_exact(self._sock, length) if length else b""
-                frames.append((kind, payload))
+        prefetcher; ``_io_lock`` keeps round trips whole.
+
+        Failure handling rides the shared RetryPolicy: a round trip that
+        dies BEFORE any response byte arrived (server restart, injected
+        ``queue_fetch`` fault) reconnects and re-issues the request — the
+        server pops queue items only while writing the response, so an
+        unanswered request consumed nothing and the re-request cannot
+        skip data. Once response bytes have been read, a failure is NOT
+        retried (items may already be popped server-side; a blind
+        re-request could silently lose them) and surfaces loudly.
+        """
+
+        def _round_trip() -> List:
+            response_started = False
+            try:
+                with self._io_lock:
+                    rt_faults.inject("queue_fetch", task=queue_index)
+                    self._sock.sendall(_REQUEST.pack(
+                        OP_GET_BATCH, queue_index, self._max_batch))
+                    (count,) = _BATCH_HEADER.unpack(
+                        _recv_exact(self._sock, _BATCH_HEADER.size))
+                    response_started = True
+                    frames = []
+                    for _ in range(count):
+                        kind, length = _FRAME.unpack(
+                            _recv_exact(self._sock, _FRAME.size))
+                        payload = (_recv_exact(self._sock, length)
+                                   if length else b"")
+                        frames.append((kind, payload))
+                return frames
+            except (ConnectionError, OSError) as e:
+                if response_started:
+                    raise RuntimeError(
+                        f"queue fetch for index {queue_index} died "
+                        f"mid-response; items may be lost — not retrying: "
+                        f"{e}") from e
+                raise
+
+        def _redial(error: BaseException) -> None:
+            if isinstance(error, (ConnectionError, OSError)):
+                self._reconnect()
+
+        frames = self._retry.call(
+            _round_trip, describe=f"fetch queue {queue_index}",
+            on_retry=_redial)
         items: List = []
         for kind, payload in frames:
             if kind == KIND_SENTINEL:

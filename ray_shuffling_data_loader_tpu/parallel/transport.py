@@ -28,6 +28,8 @@ import struct
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
+from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
@@ -159,38 +161,46 @@ class TcpTransport:
 
     def connect(self, retries: int = 30,
                 initial_backoff_s: float = 0.1) -> None:
-        """Dial every remote peer, retrying to absorb startup skew."""
-        import time
+        """Dial every remote peer, retrying to absorb startup skew.
+
+        The redial schedule is the shared ``RetryPolicy`` for the
+        ``transport`` component: exponential backoff with decorrelated
+        jitter (capped at 5s) — a whole slice's hosts dialing a
+        late-arriving peer de-synchronize instead of re-dialing in
+        lockstep at a fixed interval. The last underlying ``OSError`` is
+        carried in the raised :class:`TransportError` message.
+        """
+        policy = rt_retry.RetryPolicy.for_component(
+            "transport", retry_max_attempts=retries + 1,
+            retry_initial_backoff_s=initial_backoff_s,
+            retry_max_backoff_s=5.0,
+            retryable=lambda e: isinstance(e, OSError))
         for peer in range(self.world):
             if peer == self.host_id:
                 continue
             host, port = self.addresses[peer]
-            backoff = initial_backoff_s
-            last_err: Optional[Exception] = None
-            for attempt in range(retries + 1):
-                try:
-                    sock = socket.create_connection((host, port), timeout=30)
-                    # Drop the dial timeout: a timed-out sendall after a
-                    # partial write would corrupt the framed stream. Blocking
-                    # sends + the receiver-side recv timeout handle dead peers.
-                    sock.settimeout(None)
-                    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                    # connect() runs before any send/recv traffic exists
-                    # (single-threaded setup phase), so the per-peer send
-                    # locks it creates cannot yet have contenders:
-                    # rsdl-lint: disable=lock-mutation
-                    self._peers[peer] = sock
-                    self._peer_locks[peer] = threading.Lock()
-                    last_err = None
-                    break
-                except OSError as e:
-                    last_err = e
-                    time.sleep(backoff)
-                    backoff = min(backoff * 2, 5.0)
-            if last_err is not None:
+
+            def _dial(host=host, port=port, peer=peer):
+                sock = socket.create_connection((host, port), timeout=30)
+                # Drop the dial timeout: a timed-out sendall after a
+                # partial write would corrupt the framed stream. Blocking
+                # sends + the receiver-side recv timeout handle dead peers.
+                sock.settimeout(None)
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                # connect() runs before any send/recv traffic exists
+                # (single-threaded setup phase), so the per-peer send
+                # locks it creates cannot yet have contenders:
+                # rsdl-lint: disable=lock-mutation
+                self._peers[peer] = sock
+                self._peer_locks[peer] = threading.Lock()
+
+            try:
+                policy.call(_dial, describe=f"dial peer {peer}")
+            except OSError as e:
                 raise TransportError(
                     f"host {self.host_id} could not reach peer {peer} at "
-                    f"{host}:{port}: {last_err}")
+                    f"{host}:{port} after {retries + 1} attempts: "
+                    f"{type(e).__name__}: {e}")
         logger.info("host %d connected to %d peers", self.host_id,
                     self.world - 1)
 
@@ -301,6 +311,9 @@ class TcpTransport:
         """
         if timeout_s is None:
             timeout_s = self._recv_timeout_s
+        # Fault site: fires BEFORE the inbox pop, so the message is not
+        # consumed — a caller-level retry of recv() is always safe.
+        rt_faults.inject("transport_recv", epoch=tag[0], task=tag[1])
         key = (src, tag)
         import time
         deadline = time.monotonic() + timeout_s
@@ -351,6 +364,11 @@ class TcpTransport:
         from ray_shuffling_data_loader_tpu import native
 
         def _send_frame(s: socket.socket) -> None:
+            # Fault site fires inside the frame sender, so an injected
+            # send fault exercises the SAME redial+resend path a real
+            # socket error takes (and its per-key budget means the
+            # resend on the fresh connection goes through).
+            rt_faults.inject("transport_send", epoch=epoch, task=reducer)
             if (native.available()
                     and memoryview(payload).nbytes >= _NATIVE_PUMP_MIN_BYTES):
                 # header + payload in one GIL-free writev stream: one GIL
@@ -363,7 +381,7 @@ class TcpTransport:
         with self._peer_locks[dest]:
             try:
                 _send_frame(sock)
-            except OSError as first_err:
+            except (OSError, rt_faults.InjectedFault) as first_err:
                 # Elastic path: one redial + resend. The receiver discards
                 # nothing on its side — a partial frame on the old
                 # connection kills only that connection's recv loop, and

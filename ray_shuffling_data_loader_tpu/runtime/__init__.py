@@ -16,12 +16,23 @@ watchdog's stats hookup):
   resolution) that turns bench-only mitigations like
   ``RSDL_BENCH_DEVICE_REBATCH=0`` into library defaults
   (``RSDL_DEVICE_REBATCH=0``) with per-component overrides.
+- :mod:`.retry` — the ONE bounded/jittered :class:`RetryPolicy` every
+  retry loop in the pipeline routes through (executor task retries,
+  transport redial, remote-queue fetch, lineage recompute).
+- :mod:`.faults` — seeded, deterministic fault injection
+  (``RSDL_CHAOS_SPEC``) with named sites threaded through the hot
+  paths, plus the :class:`QuarantinedFile` report vocabulary.
 """
 
 from ray_shuffling_data_loader_tpu.runtime import (  # noqa: F401
-    policy, release, watchdog)
+    faults, policy, release, retry, watchdog)
+from ray_shuffling_data_loader_tpu.runtime.faults import (  # noqa: F401
+    InjectedFault, QuarantinedFile)
+from ray_shuffling_data_loader_tpu.runtime.retry import (  # noqa: F401
+    RetryPolicy)
 from ray_shuffling_data_loader_tpu.runtime.watchdog import (  # noqa: F401
     StallReport, Watchdog, get_watchdog)
 
-__all__ = ["policy", "release", "watchdog", "StallReport", "Watchdog",
-           "get_watchdog"]
+__all__ = ["faults", "policy", "release", "retry", "watchdog",
+           "InjectedFault", "QuarantinedFile", "RetryPolicy",
+           "StallReport", "Watchdog", "get_watchdog"]

@@ -68,6 +68,21 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     "trim_cooldown_s": (1.0, float),
     # Watchdog monitor thread poll interval.
     "watchdog_poll_interval_s": (0.05, float),
+    # Shared RetryPolicy defaults (runtime/retry.py): total attempts,
+    # decorrelated-jitter backoff bounds, and a wall-clock deadline for
+    # the whole call-plus-retries (<= 0 means no deadline). Resolved per
+    # component, so e.g. RSDL_TRANSPORT_RETRY_MAX_ATTEMPTS=60 deepens
+    # only the transport's connect redial budget.
+    "retry_max_attempts": (3, int),
+    "retry_initial_backoff_s": (0.05, float),
+    "retry_max_backoff_s": (2.0, float),
+    "retry_deadline_s": (0.0, float),
+    # What shuffle_map does with a corrupt/unreadable input file after
+    # read retries are exhausted: "raise" (fail the map task; lineage
+    # recovery then retries it, and only exhausted recovery poisons the
+    # run) or "skip" (quarantine the file into a structured
+    # QuarantinedFile report and shuffle the remaining files).
+    "on_bad_file": ("raise", str),
 }
 
 _lock = threading.Lock()

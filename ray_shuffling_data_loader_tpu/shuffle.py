@@ -32,7 +32,8 @@ from __future__ import annotations
 
 import threading
 import timeit
-from typing import (Callable, Dict, List, Optional, Sequence, Tuple, Union)
+from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple,
+                    Union)
 
 import numpy as np
 import pyarrow as pa
@@ -41,6 +42,8 @@ import pyarrow.parquet as pq
 from ray_shuffling_data_loader_tpu import executor as ex
 from ray_shuffling_data_loader_tpu import stats as stats_mod
 from ray_shuffling_data_loader_tpu.ops import partition as ops
+from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
 from ray_shuffling_data_loader_tpu.utils import fileio
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
@@ -93,6 +96,27 @@ def derive_gather_threads(concurrent_reduces: int, pool_workers: int,
     cores = (_os.cpu_count() or 1) // max(1, host_share)
     concurrent = max(1, min(concurrent_reduces, pool_workers))
     return max(1, min(16, cores // concurrent))
+
+def _transient_read_retryable(error: BaseException) -> bool:
+    """Map-read in-place retry predicate: an IO blip (NFS/GCS hiccup)
+    heals on retry; corrupt content (``ArrowInvalid``) and injected
+    task faults do not and must surface to quarantine/lineage."""
+    return isinstance(error, OSError) and not isinstance(
+        error, rt_faults.InjectedFault)
+
+
+def default_fault_policies() -> Dict[str, Any]:
+    """Per-stage RetryPolicy objects, resolved from the runtime policy
+    registry (``RSDL_RETRY_*`` globally, ``RSDL_MAP_READ_RETRY_*`` /
+    ``RSDL_REDUCE_RETRY_*`` / ``RSDL_LINEAGE_RETRY_*`` per stage).
+    Built once per shuffle driver and shared by every epoch."""
+    return {
+        "read": rt_retry.RetryPolicy.for_component(
+            "map_read", retryable=_transient_read_retryable),
+        "reduce": rt_retry.RetryPolicy.for_component("reduce"),
+        "lineage": rt_retry.RetryPolicy.for_component("lineage"),
+    }
+
 
 # How long shuffle() waits for consumers to release tables when
 # max_inflight_bytes is exceeded before proceeding with a warning.
@@ -444,6 +468,24 @@ class LazyChunk:
         return self.shard.table.take(self.indices)
 
 
+def _read_map_table(filename: str, epoch: int, file_index: int,
+                    read_retry: Optional[rt_retry.RetryPolicy]) -> pa.Table:
+    """The map task's Parquet read, as one named fault site plus an
+    in-place retry for transient IO errors (an NFS/GCS blip heals on
+    retry; a corrupt file does not, so ``ArrowInvalid`` is not retried
+    and surfaces to the quarantine policy in :func:`shuffle_map`).
+
+    ``faults.inject`` sits OUTSIDE the retried read on purpose: an
+    injected fault simulates a *lost task*, and must surface to the
+    lineage-recovery machinery under test rather than be absorbed here.
+    """
+    rt_faults.inject("map_read", epoch=epoch, task=file_index)
+    if read_retry is None:
+        return fileio.read_parquet(filename)
+    return read_retry.call(fileio.read_parquet, filename,
+                           describe=f"read {filename}")
+
+
 def shuffle_map(filename: str,
                 num_reducers: int,
                 seed: int,
@@ -451,10 +493,23 @@ def shuffle_map(filename: str,
                 file_index: int,
                 stats_collector=None,
                 map_transform: Optional[MapTransform] = None,
-                file_cache: Optional[FileTableCache] = None) -> MapShard:
+                file_cache: Optional[FileTableCache] = None,
+                on_bad_file: str = "raise",
+                read_retry: Optional[rt_retry.RetryPolicy] = None):
     """Read one file and plan the scatter of its rows across reducers
     (reference: shuffle.py:199-226 — but the per-reducer gather is deferred
-    to the reduce task, which fuses it with the shuffle permutation)."""
+    to the reduce task, which fuses it with the shuffle permutation).
+
+    Returns a :class:`MapShard`, or — when the file is corrupt/unreadable
+    after ``read_retry`` and ``on_bad_file="skip"`` — a structured
+    :class:`runtime.faults.QuarantinedFile` report that the reduce gather
+    drops (recorded in ``stats.fault_stats()``, never silent). With the
+    default ``on_bad_file="raise"`` a bad file fails the map task; lineage
+    recovery retries it, and only exhausted recovery poisons the run.
+    """
+    if on_bad_file not in ("raise", "skip"):
+        raise ValueError(
+            f"on_bad_file must be 'raise' or 'skip', got {on_bad_file!r}")
     if stats_collector is not None:
         stats_collector.map_start(epoch)
     start = timeit.default_timer()
@@ -464,7 +519,25 @@ def shuffle_map(filename: str,
             # Local path or remote URI (gs://, s3://, ... — the reference
             # reads via smart_open, reference: shuffle.py:7,208); the cache
             # above keys on the full URI string either way.
-            table = fileio.read_parquet(filename)
+            try:
+                table = _read_map_table(filename, epoch, file_index,
+                                        read_retry)
+            except (OSError, pa.ArrowInvalid) as e:
+                if on_bad_file != "skip":
+                    raise
+                report = rt_faults.QuarantinedFile(
+                    filename=filename, epoch=epoch, file_index=file_index,
+                    error=f"{type(e).__name__}: {e}")
+                stats_mod.fault_stats().record_quarantine(report)
+                logger.error(
+                    "quarantined unreadable input file %s (epoch %d, "
+                    "file %d): %s; shuffling the remaining files "
+                    "(on_bad_file='skip')", filename, epoch, file_index, e)
+                if stats_collector is not None:
+                    stats_collector.map_done(
+                        epoch, timeit.default_timer() - start,
+                        timeit.default_timer() - start)
+                return report
             if map_transform is not None:
                 table = map_transform(table)
             if file_cache is not None:
@@ -666,21 +739,161 @@ def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
     return shuffled
 
 
+class EpochLineage:
+    """Recompute lost map outputs from their ``(seed, epoch, file)`` lineage.
+
+    Ray reconstructs a lost object by re-running the task recorded in its
+    lineage; here every map task is a pure function of
+    ``(seed, epoch, file_index)`` (the determinism contract checkpoint.py
+    already exploits), so the lineage IS those three integers plus the
+    map configuration this object captures. When a reduce gather observes
+    a failed map ref it calls :meth:`recover`: the first reducer to
+    observe the failure recomputes the map task **inline on its own
+    worker thread** (never re-submitted to the pool — all workers may be
+    reduce tasks blocked on this very output, and a pool-queued recompute
+    behind them would deadlock; the FIFO submission-order argument in the
+    module docstring only covers the original submission). Every other
+    reducer waits on the first one's result, so a lost map is recomputed
+    exactly once per epoch no matter how many reducers need it.
+
+    Recovery is bounded by a :class:`runtime.retry.RetryPolicy`; a
+    recovery that exhausts its attempts raises (and is cached, so later
+    reducers fail fast instead of re-running a known-dead recompute) —
+    those are the only map failures that reach the ``ShuffleFailure``
+    poison pill. Recomputed shards are bit-identical to the lost ones
+    (seeded RNG, row-order-preserving transforms), so the consumed batch
+    stream is unchanged by recovery.
+    """
+
+    class _Cell:
+        __slots__ = ("done", "result", "error")
+
+        def __init__(self):
+            self.done = threading.Event()
+            self.result = None
+            self.error: Optional[BaseException] = None
+
+    def __init__(self, filenames: Sequence[str], num_reducers: int,
+                 seed: int, epoch: int, stats_collector=None,
+                 map_transform: Optional[MapTransform] = None,
+                 file_cache: Optional[FileTableCache] = None,
+                 retry_policy: Optional[rt_retry.RetryPolicy] = None,
+                 on_bad_file: str = "raise",
+                 read_retry: Optional[rt_retry.RetryPolicy] = None):
+        self._filenames = list(filenames)
+        self._num_reducers = num_reducers
+        self._seed = seed
+        self._epoch = epoch
+        self._stats_collector = stats_collector
+        self._map_transform = map_transform
+        self._file_cache = file_cache
+        self._retry = (retry_policy if retry_policy is not None
+                       else rt_retry.RetryPolicy.for_component("lineage"))
+        self._on_bad_file = on_bad_file
+        self._read_retry = read_retry
+        self._lock = threading.Lock()
+        self._cells: Dict[int, EpochLineage._Cell] = {}
+        self.recomputes = 0
+
+    def recover(self, file_index: int, cause: BaseException):
+        """Return the recomputed output of map task ``file_index``
+        (a MapShard or QuarantinedFile), recomputing it at most once."""
+        with self._lock:
+            cell = self._cells.get(file_index)
+            claimed = cell is None
+            if claimed:
+                cell = self._cells[file_index] = EpochLineage._Cell()
+        if claimed:
+            self._recompute(file_index, cell, cause)
+        else:
+            cell.done.wait()
+        if cell.error is not None:
+            # Re-raise the recompute's own failure (same type as the
+            # original — the task is deterministic), chained to the first
+            # observed one: consumers keep matching on the real exception
+            # class (ValueError from a bad transform, FileNotFoundError
+            # from a missing file), with lineage exhaustion in the chain.
+            raise cell.error from cause
+        return cell.result
+
+    def _recompute(self, file_index: int, cell: "EpochLineage._Cell",
+                   cause: BaseException) -> None:
+        start = timeit.default_timer()
+        logger.warning(
+            "map task %d (epoch %d) failed (%s); recomputing from lineage",
+            file_index, self._epoch, cause)
+        try:
+            cell.result = self._retry.call(
+                shuffle_map, self._filenames[file_index],
+                self._num_reducers, self._seed, self._epoch, file_index,
+                self._stats_collector, self._map_transform,
+                self._file_cache, self._on_bad_file, self._read_retry,
+                describe=f"map recompute e{self._epoch} f{file_index}")
+        except BaseException as e:  # noqa: BLE001 - cached + re-raised
+            stats_mod.fault_stats().record_exhausted("lineage")
+            cell.error = e
+        else:
+            latency = timeit.default_timer() - start
+            with self._lock:
+                self.recomputes += 1
+            stats_mod.fault_stats().record_recompute("lineage", latency)
+            logger.info(
+                "recomputed map task %d (epoch %d) from lineage in %.3fs",
+                file_index, self._epoch, latency)
+        finally:
+            cell.done.set()
+
+
 def _reduce_task(reduce_index: int, seed: int, epoch: int,
                  map_refs: Sequence[ex.TaskRef], stats_collector,
                  reduce_transform: Optional[ReduceTransform] = None,
                  spill_manager=None,
-                 gather_threads: Optional[int] = None) -> pa.Table:
+                 gather_threads: Optional[int] = None,
+                 lineage: Optional[EpochLineage] = None,
+                 retry_policy: Optional[rt_retry.RetryPolicy] = None
+                 ) -> pa.Table:
     """Executor wrapper: resolve this reducer's chunk from every map output.
 
     Equivalent of Ray resolving ``shuffle_reduce.remote(*refs)`` argument
     refs (reference: shuffle.py:182-187) — but the chunks stay lazy
     (index arrays into the map tables) until the fused reduce gathers them.
+
+    Fault handling (this is where lineage recovery hooks in): a failed
+    map ref is recomputed via ``lineage.recover`` instead of propagating;
+    a :class:`runtime.faults.QuarantinedFile` marker (``on_bad_file=
+    "skip"``) drops that file's chunk; and the gather+shuffle itself is
+    re-run under ``retry_policy`` on failure — safe because the whole
+    body is a pure function of ``(seed, epoch, reduce_index)`` and the
+    (lazy, repeatable) map outputs. Only exhausted recovery escapes.
     """
-    chunks = [ref.result()[reduce_index] for ref in map_refs]
-    shuffled = shuffle_reduce(reduce_index, seed, epoch, chunks,
+
+    def _gather_and_shuffle() -> pa.Table:
+        rt_faults.inject("reduce_gather", epoch=epoch, task=reduce_index)
+        chunks = []
+        for file_index, ref in enumerate(map_refs):
+            try:
+                shard = ref.result()
+            except Exception as e:  # noqa: BLE001 - recovered from lineage
+                if lineage is None:
+                    raise
+                shard = lineage.recover(file_index, e)
+            if isinstance(shard, rt_faults.QuarantinedFile):
+                continue  # dropped file: shuffle the surviving inputs
+            chunks.append(shard[reduce_index])
+        return shuffle_reduce(reduce_index, seed, epoch, chunks,
                               stats_collector, reduce_transform,
                               gather_threads)
+
+    if retry_policy is None:
+        shuffled = _gather_and_shuffle()
+    else:
+        def _recovered(failed_attempts: int, elapsed_s: float) -> None:
+            stats_mod.fault_stats().record_recompute("reduce", elapsed_s)
+
+        shuffled = retry_policy.call(
+            _gather_and_shuffle,
+            describe=f"reduce e{epoch} r{reduce_index}",
+            on_recovery=_recovered)
     return account_and_maybe_spill(shuffled, spill_manager)
 
 
@@ -729,23 +942,40 @@ def shuffle_epoch(epoch: int,
                   file_cache: Optional[FileTableCache] = None,
                   reduce_transform: Optional[ReduceTransform] = None,
                   spill_manager=None,
-                  gather_threads: Optional[int] = None) -> List[ex.TaskRef]:
+                  gather_threads: Optional[int] = None,
+                  on_bad_file: str = "raise",
+                  fault_policies: Optional[Dict[str, Any]] = None
+                  ) -> List[ex.TaskRef]:
     """Launch one epoch's map/reduce and route outputs to trainers
-    (reference: shuffle.py:163-196). Returns the reducer TaskRefs."""
+    (reference: shuffle.py:163-196). Returns the reducer TaskRefs.
+
+    ``fault_policies`` carries the per-stage RetryPolicy objects built
+    once by the driver (keys ``read``/``reduce``/``lineage``); when
+    omitted they resolve from the runtime policy registry here — so a
+    directly-driven epoch still recovers lost maps from lineage.
+    """
     if stats_collector is not None:
         stats_collector.epoch_start(epoch)
+    policies = fault_policies if fault_policies is not None \
+        else default_fault_policies()
     map_refs = [
         pool.submit(shuffle_map, filename, num_reducers, seed, epoch,
-                    file_index, stats_collector, map_transform, file_cache)
+                    file_index, stats_collector, map_transform, file_cache,
+                    on_bad_file, policies.get("read"))
         for file_index, filename in enumerate(filenames)
     ]
     if gather_threads is None:
         gather_threads = derive_gather_threads(num_reducers,
                                                pool.num_workers)
+    lineage = EpochLineage(filenames, num_reducers, seed, epoch,
+                           stats_collector, map_transform, file_cache,
+                           retry_policy=policies.get("lineage"),
+                           on_bad_file=on_bad_file,
+                           read_retry=policies.get("read"))
     reduce_refs = [
         pool.submit(_reduce_task, reduce_index, seed, epoch, map_refs,
                     stats_collector, reduce_transform, spill_manager,
-                    gather_threads)
+                    gather_threads, lineage, policies.get("reduce"))
         for reduce_index in range(num_reducers)
     ]
     for trainer_idx, batches in enumerate(
@@ -773,7 +1003,8 @@ def shuffle(filenames: Sequence[str],
             reduce_transform: Optional[ReduceTransform] = None,
             task_retries: int = 0,
             max_inflight_bytes: Optional[int] = None,
-            spill_dir: Optional[str] = None
+            spill_dir: Optional[str] = None,
+            on_bad_file: Optional[str] = None
             ) -> Union[stats_mod.TrialStats, float]:
     """Multi-epoch pipelined shuffle driver (reference: shuffle.py:79-160).
 
@@ -803,6 +1034,17 @@ def shuffle(filenames: Sequence[str],
     ``start_epoch`` > 0 (checkpoint resume) skips shuffling the already-
     fully-consumed epochs; epoch PRNG keys depend only on (seed, epoch),
     so the produced epochs replay exactly.
+
+    Failure semantics (runtime/faults.py, runtime/retry.py): a failed
+    map task is recomputed from its ``(seed, epoch, file)`` lineage by
+    the first reduce gather that observes it; a failed reduce body is
+    re-run in-task; both under bounded, jittered RetryPolicies — and
+    only exhausted recovery propagates to the caller (and from there to
+    the ``ShuffleFailure`` poison pill). ``on_bad_file`` (default
+    ``"raise"``, policy key ``RSDL_SHUFFLE_ON_BAD_FILE``) set to
+    ``"skip"`` quarantines a corrupt/unreadable input file into a
+    structured ``QuarantinedFile`` report and shuffles the remaining
+    files instead of failing the epoch.
 
     Returns ``TrialStats`` when ``collect_stats`` else the wall-clock
     duration in seconds (reference: shuffle.py:155-160).
@@ -838,6 +1080,10 @@ def shuffle(filenames: Sequence[str],
     overlap = max(1, min(max_concurrent_epochs, num_epochs - start_epoch))
     gather_threads = derive_gather_threads(
         num_reducers * overlap, pool.num_workers)
+    from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
+    on_bad_file = rt_policy.resolve("shuffle", "on_bad_file",
+                                    override=on_bad_file)
+    fault_policies = default_fault_policies()
 
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}
@@ -887,7 +1133,7 @@ def shuffle(filenames: Sequence[str],
                 epoch_idx, filenames, batch_consumer, num_reducers,
                 num_trainers, pool, seed, start, stats_collector,
                 map_transform, file_cache, reduce_transform, spill_manager,
-                gather_threads)
+                gather_threads, on_bad_file, fault_policies)
         # Final drain: wait for all remaining reducer tasks
         # (reference: shuffle.py:148-151).
         for epoch_idx in sorted(in_progress):
@@ -937,7 +1183,8 @@ def shuffle_with_stats(
         reduce_transform: Optional[ReduceTransform] = None,
         task_retries: int = 0,
         max_inflight_bytes: Optional[int] = None,
-        spill_dir: Optional[str] = None
+        spill_dir: Optional[str] = None,
+        on_bad_file: Optional[str] = None
 ) -> Tuple[stats_mod.TrialStats, List]:
     """Shuffle plus a concurrent memory-utilization sampler thread
     (reference: shuffle.py:21-55). Forwards the workload hooks
@@ -956,7 +1203,8 @@ def shuffle_with_stats(
                               reduce_transform=reduce_transform,
                               task_retries=task_retries,
                               max_inflight_bytes=max_inflight_bytes,
-                              spill_dir=spill_dir)
+                              spill_dir=spill_dir,
+                              on_bad_file=on_bad_file)
     finally:
         done_event.set()
     return trial_stats, store_stats
@@ -975,7 +1223,8 @@ def shuffle_no_stats(filenames: Sequence[str],
                      reduce_transform: Optional[ReduceTransform] = None,
                      task_retries: int = 0,
                      max_inflight_bytes: Optional[int] = None,
-                     spill_dir: Optional[str] = None
+                     spill_dir: Optional[str] = None,
+                     on_bad_file: Optional[str] = None
                      ) -> Tuple[float, List]:
     """Duration-only variant (reference: shuffle.py:58-76)."""
     duration = shuffle(filenames, batch_consumer, num_epochs, num_reducers,
@@ -985,7 +1234,7 @@ def shuffle_no_stats(filenames: Sequence[str],
                        reduce_transform=reduce_transform,
                        task_retries=task_retries,
                        max_inflight_bytes=max_inflight_bytes,
-                       spill_dir=spill_dir)
+                       spill_dir=spill_dir, on_bad_file=on_bad_file)
     return duration, []
 
 
@@ -1006,6 +1255,7 @@ def run_shuffle_in_background(
         task_retries: int = 0,
         max_inflight_bytes: Optional[int] = None,
         spill_dir: Optional[str] = None,
+        on_bad_file: Optional[str] = None,
         on_failure: Optional[Callable[[BaseException], None]] = None
         ) -> ex.TaskRef:
     """Launch the whole multi-epoch shuffle as one background task.
@@ -1036,7 +1286,7 @@ def run_shuffle_in_background(
                            reduce_transform=reduce_transform,
                            task_retries=task_retries,
                            max_inflight_bytes=max_inflight_bytes,
-                           spill_dir=spill_dir)
+                           spill_dir=spill_dir, on_bad_file=on_bad_file)
         except BaseException as e:  # noqa: BLE001 - forwarded to consumers
             if on_failure is not None:
                 try:

@@ -26,6 +26,7 @@ from typing import Callable, Optional
 
 import pyarrow as pa
 
+from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
@@ -76,6 +77,11 @@ class SpilledTable:
         from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
         with self._lock:
             if self._table is None:
+                # Fault site: a spilled output that cannot be read back is
+                # lost data — this must fail the consumer loudly (there is
+                # no second copy; the in-memory table was dropped when the
+                # handle replaced it).
+                rt_faults.inject("spill_read")
                 with trace_span("spill_load"):
                     with pa.memory_map(self._path) as source:
                         self._table = pa.ipc.open_file(source).read_all()
@@ -125,10 +131,21 @@ class SpillManager:
         with self._lock:
             path = os.path.join(self._dir, f"reduce_{self._seq}.arrow")
             self._seq += 1
-        with trace_span("spill_write"):
-            with pa.OSFile(path, "wb") as sink:
-                with pa.ipc.new_file(sink, table.schema) as writer:
-                    writer.write_table(table)
+        try:
+            rt_faults.inject("spill_write", task=self._seq - 1)
+            with trace_span("spill_write"):
+                with pa.OSFile(path, "wb") as sink:
+                    with pa.ipc.new_file(sink, table.schema) as writer:
+                        writer.write_table(table)
+        except (OSError, rt_faults.InjectedFault) as e:
+            # Graceful degradation: a failed spill write (disk full, dying
+            # scratch volume, injected fault) keeps the in-memory table —
+            # the pipeline runs hotter than its budget but loses nothing.
+            logger.warning(
+                "spill write failed (%s); keeping reducer output in "
+                "memory (over-budget until consumers release)", e)
+            _unlink_quiet(path)
+            return table
         size = os.path.getsize(path)
         with self._lock:
             self.spill_count += 1

@@ -11,8 +11,10 @@ Report schema: the trial CSV and epoch CSV column sets reproduce the
 reference's exactly (reference: stats.py:305-355,468-505) so downstream
 tooling reads either; the trial CSV additionally APPENDS the
 watchdog/stall columns (``watchdog_events``, ``stall_escalations``,
-``fallbacks_engaged`` — process totals at write time), which
-position-indexed reference tooling never sees. Memory utilization sampling replaces the raylet gRPC
+``fallbacks_engaged``) and the fault/recovery columns
+(``faults_injected``, ``fault_retries``, ``fault_recomputes``,
+``fault_quarantines``, ``fault_recoveries_exhausted``) — process totals
+at write time — which position-indexed reference tooling never sees. Memory utilization sampling replaces the raylet gRPC
 store probe (reference: stats.py:598-632) with host RSS + native buffer-pool
 bytes + optional TPU HBM via ``device.memory_stats()``.
 """
@@ -409,6 +411,95 @@ def watchdog_stats() -> WatchdogStats:
 
 
 # ---------------------------------------------------------------------------
+# Fault / recovery accounting (runtime/faults.py injects, runtime/retry.py
+# and the shuffle's lineage recovery record; bench.py and the trial CSV
+# read the process totals)
+# ---------------------------------------------------------------------------
+
+
+class FaultStats:
+    """Process-wide sink for fault-injection and recovery events.
+
+    Counters are monotonic — snapshot before/after a run to measure that
+    run's activity (the ``watchdog_stats``/``process_spill_totals``
+    protocol). ``recomputes`` counts tasks re-executed successfully after
+    a failure (lineage map recomputes AND in-task reduce/transfer
+    re-runs); ``retries`` counts every RetryPolicy backoff taken;
+    ``exhausted`` counts recoveries that ran out of attempts (the only
+    failures that reach the ``ShuffleFailure`` poison pill).
+    """
+
+    _RECENT = 32
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._injected = 0
+        self._retries = 0
+        self._recomputes = 0
+        self._quarantines = 0
+        self._exhausted = 0
+        self._recovery_latency_total_s = 0.0
+        self._recovery_latency_max_s = 0.0
+        self._by_site: Dict[str, int] = {}
+        self._recent_quarantines: List[Dict[str, Any]] = []
+
+    def record_injected(self, site: str, epoch=None, task=None) -> None:
+        with self._lock:
+            self._injected += 1
+            self._by_site[site] = self._by_site.get(site, 0) + 1
+
+    def record_retry(self, component: str) -> None:
+        with self._lock:
+            self._retries += 1
+
+    def record_recompute(self, component: str, latency_s: float) -> None:
+        with self._lock:
+            self._recomputes += 1
+            self._recovery_latency_total_s += latency_s
+            self._recovery_latency_max_s = max(
+                self._recovery_latency_max_s, latency_s)
+
+    def record_quarantine(self, report) -> None:
+        """``report`` is a ``runtime.faults.QuarantinedFile`` (duck-typed:
+        ``as_dict()``)."""
+        with self._lock:
+            self._quarantines += 1
+            self._recent_quarantines.append(report.as_dict())
+            del self._recent_quarantines[:-self._RECENT]
+
+    def record_exhausted(self, component: str) -> None:
+        with self._lock:
+            self._exhausted += 1
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "injected": self._injected,
+                "retries": self._retries,
+                "recomputes": self._recomputes,
+                "quarantines": self._quarantines,
+                "exhausted": self._exhausted,
+                "recovery_latency_total_s": self._recovery_latency_total_s,
+                "recovery_latency_max_s": self._recovery_latency_max_s,
+                "injected_by_site": dict(self._by_site),
+                "recent_quarantines": list(self._recent_quarantines),
+            }
+
+    def __getitem__(self, key: str):
+        """Mapping-style access to the current totals
+        (``fault_stats()["recomputes"]``)."""
+        return self.snapshot()[key]
+
+
+_fault_stats = FaultStats()
+
+
+def fault_stats() -> FaultStats:
+    """THE process-wide fault/recovery recorder."""
+    return _fault_stats
+
+
+# ---------------------------------------------------------------------------
 # Memory utilization sampler (reference: stats.py:598-648, raylet gRPC ->
 # host/pool/HBM introspection)
 # ---------------------------------------------------------------------------
@@ -513,6 +604,9 @@ TRIAL_FIELDNAMES = [
     "min_time_to_consume",
     # Appended past the reference's column set (see module docstring).
     "watchdog_events", "stall_escalations", "fallbacks_engaged",
+    # Fault/recovery totals (fault_stats(); process totals at write time).
+    "faults_injected", "fault_retries", "fault_recomputes",
+    "fault_quarantines", "fault_recoveries_exhausted",
 ]
 
 EPOCH_FIELDNAMES = [
@@ -592,6 +686,7 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
     }
 
     wd = watchdog_stats().snapshot()
+    fs = fault_stats().snapshot()
 
     path, header = _open_report("trial")
     logger.info("Writing trial stats to %s", path)
@@ -605,6 +700,11 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
             row["watchdog_events"] = wd["watchdog_events"]
             row["stall_escalations"] = wd["stall_escalations"]
             row["fallbacks_engaged"] = wd["fallbacks_engaged"]
+            row["faults_injected"] = fs["injected"]
+            row["fault_retries"] = fs["retries"]
+            row["fault_recomputes"] = fs["recomputes"]
+            row["fault_quarantines"] = fs["quarantines"]
+            row["fault_recoveries_exhausted"] = fs["exhausted"]
             row["duration"] = stats.duration
             row_tp = num_epochs * num_rows / stats.duration
             row["row_throughput"] = row_tp

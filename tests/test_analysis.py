@@ -239,6 +239,43 @@ GC_WAIT_OK = """
         return release.wait_while(over_budget, timeout_s=timeout_s)
 """
 
+RETRY_WHILE_BAD = """
+    import time
+
+    def fetch(conn):
+        while True:
+            try:
+                return conn.fetch()
+            except ConnectionError:
+                time.sleep(1.0)
+"""
+
+RETRY_FIXED_SLEEP_BAD = """
+    import time
+
+    def fetch(conn, retries):
+        for _ in range(retries):
+            try:
+                return conn.fetch()
+            except ConnectionError:
+                time.sleep(0.5)  # fixed interval: lockstep re-dial
+"""
+
+RETRY_OK = """
+    from ray_shuffling_data_loader_tpu.runtime.retry import RetryPolicy
+
+    def fetch(conn):
+        # the sanctioned shape: bounded attempts, jittered backoff
+        return RetryPolicy.for_component("queue").call(conn.fetch)
+
+    def drain(queue, out):
+        while True:  # drain loop, not a retry: the handler exits
+            try:
+                out.append(queue.get_nowait())
+            except LookupError:
+                return
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -252,6 +289,8 @@ CASES = [
     ("arrow-zero-copy", ZERO_COPY_BAD, ZERO_COPY_OK, {}),
     ("swallowed-exception", SWALLOWED_BAD, SWALLOWED_OK, {}),
     ("gc-collect-in-wait", GC_WAIT_BAD, GC_WAIT_OK, {}),
+    ("unbounded-retry", RETRY_WHILE_BAD, RETRY_OK, {}),
+    ("unbounded-retry", RETRY_FIXED_SLEEP_BAD, RETRY_OK, {}),
 ]
 
 
