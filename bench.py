@@ -71,7 +71,10 @@ recompute / in-task retry / spill degrade). The run must still complete
 every selected phase — a phase that dies under chaos exits non-zero —
 and the JSON gains the fault_stats() delta (``faults_injected``,
 ``fault_retries``, ``fault_recomputes``, ``fault_quarantines``,
-``fault_recoveries_exhausted``, ``chaos_rate``). An explicit
+``fault_recoveries_exhausted``, ``chaos_rate``) plus the
+chaos/telemetry join evidence (``fault_events``,
+``fault_events_joinable`` — fault events matched to stage events by
+``(kind, epoch, task)``). An explicit
 RSDL_CHAOS_SPEC wins over the rate spec (targeted reproduction:
 ``RSDL_CHAOS_SPEC="map_read:epoch1:file2"`` fails the same way every
 run). The JSON also carries runtime-health evidence
@@ -80,6 +83,17 @@ the bulk-path progress watchdog, and the library degradation policy
 (runtime/policy.py) now owns the device-rebatch default:
 RSDL_DEVICE_REBATCH=0 is the promoted, library-wide form of
 RSDL_BENCH_DEVICE_REBATCH=0.
+
+Telemetry spine (runtime/telemetry.py): the whole invocation is
+flight-recorded (SIGUSR1 dumps the event ring + named-thread stacks at
+any moment), and the JSON carries the bottleneck verdict computed from
+recorder events — ``bottleneck_stage``, ``telemetry_stall_pct``,
+``stage_latency_ms`` (p50/p95/p99 per stage), ``telemetry_events``,
+and ``telemetry_overhead_pct`` (events x measured per-record cost over
+the timed window; contract <= 2%). RSDL_METRICS_FILE /
+RSDL_METRICS_PORT bring up the Prometheus exposition so
+``tools/rsdl_top.py`` can watch the run live; see
+examples/observability.md.
 """
 
 from __future__ import annotations
@@ -413,7 +427,8 @@ def run_ingest_multi(jax, filenames, *, num_epochs, batch_size,
         for rank in range(num_trainers):
             datasets.append(make(rank))
         threads = [threading.Thread(target=consume, args=(r, datasets[r]),
-                                    daemon=True)
+                                    daemon=True,
+                                    name=f"rsdl-bench-consume-{r}")
                    for r in range(num_trainers)]
         for t in threads:
             t.start()
@@ -829,7 +844,20 @@ def main() -> None:
             phases.insert(0, "cold")
 
     from ray_shuffling_data_loader_tpu import stats as rsdl_stats
+    from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+    from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_tel
     from ray_shuffling_data_loader_tpu.utils.tracing import maybe_profile
+
+    # Telemetry spine: the whole invocation is flight-recorded (SIGUSR1
+    # dumps the ring + named-thread stacks at any point), and the
+    # exposition exporter comes up when RSDL_METRICS_FILE /
+    # RSDL_METRICS_PORT are set so `tools/rsdl_top.py` can watch live.
+    rt_tel.install_signal_dump()
+    if (rt_policy.resolve("metrics", "metrics_file")
+            or rt_policy.resolve("metrics", "metrics_port")):
+        rt_metrics.start_exporter()
+    telemetry_per_event_s = rt_tel.measure_record_overhead()
+    events_before = rt_tel.recorder().total_recorded
 
     # Watchdog/stall totals are monotonic process counters; the JSON
     # reports this invocation's delta.
@@ -1047,6 +1075,34 @@ def main() -> None:
         record["fault_recoveries_exhausted"] = fs_delta["exhausted"]
     if chaos_rate is not None:
         record["chaos_rate"] = chaos_rate
+    # Telemetry-spine evidence (runtime/telemetry.py): the bottleneck
+    # verdict and per-stage latency decomposition are computed from
+    # flight-recorder events — not from log scraping — plus the
+    # recorder's own measured share of the timed window.
+    verdict = rt_tel.attribution().run_summary() or {}
+    record["bottleneck_stage"] = verdict.get("bottleneck_stage")
+    record["telemetry_stall_pct"] = verdict.get("stall_pct")
+    record["stage_latency_ms"] = {
+        stage: {q: s[q] for q in ("p50_ms", "p95_ms", "p99_ms")}
+        for stage, s in verdict.get("stages", {}).items()}
+    events_delta = rt_tel.recorder().total_recorded - events_before
+    timed_s = sum(p["duration_s"] for p in (cached, cold, train) if p)
+    record["telemetry_events"] = events_delta
+    record["telemetry_overhead_pct"] = (
+        round(100.0 * events_delta * telemetry_per_event_s / timed_s, 4)
+        if timed_s else 0.0)
+    if chaos_rate is not None or any(fs_delta.values()):
+        # Chaos <-> telemetry correlation: a fault event (kind = the
+        # fault-site name) is JOINABLE when a non-fault telemetry event
+        # shares its (kind, epoch, task) key.
+        events = rt_tel.recorder().events()
+        plain_keys = {(e["kind"], e.get("epoch"), e.get("task"))
+                      for e in events if "fault" not in e}
+        fault_events = [e for e in events if e.get("fault")]
+        record["fault_events"] = len(fault_events)
+        record["fault_events_joinable"] = sum(
+            1 for e in fault_events
+            if (e["kind"], e.get("epoch"), e.get("task")) in plain_keys)
     if cold is not None:
         # "disk": parquet decoded ONCE inside the timed window, later
         # epochs stream from mmap'd Arrow IPC scratch (fresh dir per

@@ -50,7 +50,8 @@ def bench_transport(payload_mb: int, sends: int) -> float:
                 world[1].recv(0, (1, 0, i))
             done.set()
 
-        t = threading.Thread(target=receiver)
+        t = threading.Thread(target=receiver,
+                             name="bench-transport-recv")
         start = timeit.default_timer()
         t.start()
         for i in range(sends):
@@ -84,7 +85,8 @@ def bench_distributed_shuffle(filenames, num_epochs: int, world_size: int,
             transports[host], max_concurrent_epochs=2, seed=0,
             file_cache=None, num_workers=2)
 
-    threads = [threading.Thread(target=run_host, args=(h,))
+    threads = [threading.Thread(target=run_host, args=(h,),
+                                name=f"bench-host-{h}")
                for h in range(world_size)]
     start = timeit.default_timer()
     for t in threads:
@@ -194,7 +196,8 @@ def bench_multi_trainer(filenames, num_epochs: int, num_trainers: int,
         except BaseException as e:  # noqa: BLE001 - re-raised in main
             errors.append(e)
 
-    threads = [threading.Thread(target=consume, args=(r,))
+    threads = [threading.Thread(target=consume, args=(r,),
+                                name=f"bench-consume-{r}")
                for r in range(num_trainers)]
     start = timeit.default_timer()
     for t in threads:
@@ -279,7 +282,9 @@ def bench_served_queue_multi(filenames, num_epochs: int, num_reducers: int,
             except BaseException as e:  # noqa: BLE001 - surfaced below
                 errors.append(e)
 
-        threads = [threading.Thread(target=consume, args=(r,), daemon=True)
+        threads = [threading.Thread(target=consume, args=(r,),
+                                    daemon=True,
+                                    name=f"bench-consume-{r}")
                    for r in range(ranks)]
         for t in threads:
             t.start()

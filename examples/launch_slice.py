@@ -168,7 +168,7 @@ def main(argv=None) -> int:
                 stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
                 text=True)
         t = threading.Thread(target=_stream, args=(proc, f"host {i}"),
-                             daemon=True)
+                             daemon=True, name=f"rsdl-slice-stream-{i}")
         t.start()
         procs.append((proc, t))
 

@@ -136,6 +136,16 @@ class Executor:
             raise ValueError(f"task_retries must be >= 0, got {task_retries}")
         self._num_workers = num_workers
         self._task_retries = task_retries
+        # Registry evidence for rsdl_top / exposition: pool width and a
+        # per-pool submission counter (labelled by thread-name prefix —
+        # the same name SIGUSR1 stack dumps show, so the two join).
+        from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+        rt_metrics.gauge("rsdl_executor_workers",
+                         "thread-pool width by pool name",
+                         pool=thread_name_prefix).set(num_workers)
+        self._tasks_submitted = rt_metrics.counter(
+            "rsdl_executor_tasks_total", "tasks submitted by pool name",
+            pool=thread_name_prefix)
         if retry_policy is None and task_retries:
             from ray_shuffling_data_loader_tpu.runtime import retry as rt
             retry_policy = rt.RetryPolicy.for_component(
@@ -152,6 +162,7 @@ class Executor:
     def submit(self, fn: Callable, *args, **kwargs) -> TaskRef:
         if self._shutdown:
             raise RuntimeError("executor is shut down")
+        self._tasks_submitted.inc()
         if self._retry_policy is not None:
             return TaskRef(self._pool.submit(self._run_with_retries, fn,
                                              args, kwargs))
@@ -164,6 +175,7 @@ class Executor:
         misleading timeout."""
         if self._shutdown:
             raise RuntimeError("executor is shut down")
+        self._tasks_submitted.inc()
         return TaskRef(self._pool.submit(fn, *args, **kwargs))
 
     def _run_with_retries(self, fn: Callable, args, kwargs) -> Any:

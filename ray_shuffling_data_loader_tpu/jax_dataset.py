@@ -36,6 +36,7 @@ from ray_shuffling_data_loader_tpu.dataset import (ShufflingDataset,
                                                    slice_batches)
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
 from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
+from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.stats import BatchWaitStats
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
@@ -265,6 +266,13 @@ class _BatchConverter:
 
         def _put():
             self._transfer_seq += 1
+            # Attempt marker (no duration — not a stage sample): carries
+            # the same task key the device_transfer fault site draws on,
+            # so an injected transfer fault joins telemetry by
+            # (kind, epoch, task) like every other site. The stage's
+            # latency samples come from the epoch-tagged transfer spans.
+            rt_telemetry.record("device_transfer", task=self._transfer_seq,
+                                attempt=True)
             rt_faults.inject("device_transfer", task=self._transfer_seq)
             return thunk()
 
@@ -465,9 +473,11 @@ def _persistent_producer(dataset: ShufflingDataset,
                     return
             else:
                 for table in dataset:
-                    with trace_span("batch_convert"):
+                    with trace_span("batch_convert", kind="convert",
+                                    epoch=epoch):
                         arrays = converter.convert(table)
-                    with trace_span("batch_transfer"):
+                    with trace_span("batch_transfer",
+                                    kind="device_transfer", epoch=epoch):
                         batch = converter.transfer(arrays)
                     if not put(("batch", epoch, batch)):
                         return
@@ -544,13 +554,14 @@ def _produce_epoch_tables(dataset: ShufflingDataset,
         pieces_f = [np.concatenate([p[0][i] for p in carry], axis=0)
                     for i in range(len(carry[0][0]))]
         pieces_l = np.concatenate([p[1] for p in carry], axis=0)
-        with trace_span("batch_transfer"):
+        with trace_span("batch_transfer", kind="device_transfer",
+                        epoch=epoch):
             return converter.transfer((pieces_f, pieces_l))
 
     tables = dataset.iter_tables()
     emitted = False  # anything put() or carried yet this epoch
     for table in tables:
-        with trace_span("table_convert"):
+        with trace_span("table_convert", kind="convert", epoch=epoch):
             features, label = converter.convert(table)
         n = table.num_rows
         if any(f.shape[0] != n for f in features) or label.shape[0] != n:
@@ -575,9 +586,11 @@ def _produce_epoch_tables(dataset: ShufflingDataset,
                 for batch_table in slice_batches(
                         itertools.chain([table], tables), bs,
                         dataset.drop_last):
-                    with trace_span("batch_convert"):
+                    with trace_span("batch_convert", kind="convert",
+                                    epoch=epoch):
                         arrays = converter.convert(batch_table)
-                    with trace_span("batch_transfer"):
+                    with trace_span("batch_transfer",
+                                    kind="device_transfer", epoch=epoch):
                         batch = converter.transfer(arrays)
                     if not put(("batch", epoch, batch)):
                         return False
@@ -624,7 +637,8 @@ def _produce_epoch_tables(dataset: ShufflingDataset,
                 nb = min(k, full_batches - done)
                 lo = offset + done * bs
                 hi = lo + nb * bs
-                with trace_span("table_transfer"):
+                with trace_span("table_transfer", kind="device_transfer",
+                                epoch=epoch):
                     item = _supervised_transfer_table(
                         converter,
                         ([f[lo:hi] for f in features], label[lo:hi]),
@@ -634,7 +648,8 @@ def _produce_epoch_tables(dataset: ShufflingDataset,
                 done += nb
             for b in range(done, full_batches):
                 lo = offset + b * bs
-                with trace_span("batch_transfer"):
+                with trace_span("batch_transfer", kind="device_transfer",
+                                epoch=epoch):
                     batch = converter.transfer(
                         ([f[lo:lo + bs] for f in features],
                          label[lo:lo + bs]))
@@ -1052,12 +1067,21 @@ class JaxShufflingDataset:
                 daemon=True, name="rsdl-jax-prefetch")
             weakref.finalize(self, _release_producer, self._stop, self._out)
             self._thread.start()
+        resume_t = None  # when the consumer last resumed after a yield
         try:
             while True:
                 wait_start = timeit.default_timer()
+                if resume_t is not None:
+                    # The gap between the previous batch's yield and this
+                    # get() is the consumer's own work — the train_step
+                    # stage of the bottleneck decomposition.
+                    rt_telemetry.record("train_step", epoch=epoch,
+                                        dur_s=wait_start - resume_t,
+                                        t=wait_start)
                 item = self._out.get()
-                self.batch_wait_stats.record(
-                    timeit.default_timer() - wait_start)
+                wait_s = timeit.default_timer() - wait_start
+                self.batch_wait_stats.record(wait_s)
+                rt_telemetry.record("batch_wait", epoch=epoch, dur_s=wait_s)
                 if isinstance(item, BaseException):
                     raise item
                 kind, item_epoch, payload = item
@@ -1067,6 +1091,7 @@ class JaxShufflingDataset:
                     continue
                 assert item_epoch == epoch, (item_epoch, epoch)
                 if kind == "end":
+                    rt_telemetry.epoch_complete(epoch, source="jax")
                     break
                 if kind == "table":
                     # Bulk device table: carve batches on-device. Later
@@ -1086,7 +1111,14 @@ class JaxShufflingDataset:
                     wd = self._converter.watchdog
                     for b in range(start, n_batches):
                         if b > start:
+                            now = timeit.default_timer()
                             self.batch_wait_stats.record(0.0)
+                            rt_telemetry.record("batch_wait", epoch=epoch,
+                                                dur_s=0.0, t=now)
+                            if resume_t is not None:
+                                rt_telemetry.record(
+                                    "train_step", epoch=epoch,
+                                    dur_s=now - resume_t, t=now)
                             batch = self._converter.slice_batch(
                                 dev_table, b, bs)
                         elif wd is not None:
@@ -1101,11 +1133,13 @@ class JaxShufflingDataset:
                             batch = self._converter.slice_batch(
                                 dev_table, b, bs)
                         yield batch
+                        resume_t = timeit.default_timer()
                     continue
                 if self._consumer_skip:
                     self._consumer_skip -= 1
                     continue
                 yield payload
+                resume_t = timeit.default_timer()
         finally:
             # Runs on normal completion AND on mid-epoch abandonment
             # (GeneratorExit from iterator.close() / going out of scope):
@@ -1179,11 +1213,14 @@ class JaxShufflingDataset:
             return False
 
         def producer():
+            epoch = getattr(self._dataset, "_epoch", None)
             try:
                 for table in self._dataset:
-                    with trace_span("batch_convert"):
+                    with trace_span("batch_convert", kind="convert",
+                                    epoch=epoch):
                         arrays = self._convert(table)
-                    with trace_span("batch_transfer"):
+                    with trace_span("batch_transfer",
+                                    kind="device_transfer", epoch=epoch):
                         batch = self._transfer(arrays)
                     if not _put(batch):
                         return
@@ -1194,17 +1231,27 @@ class JaxShufflingDataset:
         thread = threading.Thread(target=producer, daemon=True,
                                   name="rsdl-jax-prefetch")
         thread.start()
+        epoch = getattr(self._dataset, "_epoch", None)
+        resume_t = None
         try:
             while True:
                 wait_start = timeit.default_timer()
+                if resume_t is not None:
+                    rt_telemetry.record("train_step", epoch=epoch,
+                                        dur_s=wait_start - resume_t,
+                                        t=wait_start)
                 item = out.get()
-                self.batch_wait_stats.record(
-                    timeit.default_timer() - wait_start)
+                wait_s = timeit.default_timer() - wait_start
+                self.batch_wait_stats.record(wait_s)
+                rt_telemetry.record("batch_wait", epoch=epoch, dur_s=wait_s)
                 if item is SENTINEL:
+                    if epoch is not None:
+                        rt_telemetry.epoch_complete(epoch, source="jax")
                     break
                 if isinstance(item, BaseException):
                     raise item
                 yield item
+                resume_t = timeit.default_timer()
         finally:
             # Consumer done or abandoned mid-epoch: release the producer
             # (it would otherwise block forever on the bounded queue,

@@ -28,6 +28,7 @@ import time
 from typing import Any, List, Optional
 
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
@@ -228,10 +229,15 @@ class MultiQueue:
         # never half-delivers an item (chaos keyed by queue index).
         rt_faults.inject("queue_put", task=queue_index)
         self._check_open()
+        start = time.monotonic()
         try:
             self._queues[queue_index].put(item, block=block, timeout=timeout)
         except Full:
             raise Full(f"queue {queue_index} is full")
+        # Producer-side backpressure evidence: a long put means the
+        # consumer (or a bounded queue) is the slow side.
+        rt_telemetry.record("queue_put", task=queue_index,
+                            dur_s=time.monotonic() - start)
 
     def put_nowait(self, queue_index: int, item: Any) -> None:
         self.put(queue_index, item, block=False)
@@ -273,10 +279,15 @@ class MultiQueue:
         # Fault site: fires before the dequeue — no item is consumed, so
         # the caller may retry (or crash, for checkpoint-resume chaos).
         rt_faults.inject("queue_get", task=queue_index)
+        start = time.monotonic()
         try:
-            return self._queues[queue_index].get(block=block, timeout=timeout)
+            item = self._queues[queue_index].get(block=block,
+                                                 timeout=timeout)
         except Empty:
             raise Empty(f"queue {queue_index} is empty")
+        rt_telemetry.record("queue_get", task=queue_index,
+                            dur_s=time.monotonic() - start)
+        return item
 
     def get_nowait(self, queue_index: int) -> Any:
         return self.get(queue_index, block=False)

@@ -48,6 +48,7 @@ from ray_shuffling_data_loader_tpu import multiqueue as mq
 from ray_shuffling_data_loader_tpu.dataset import ShuffleFailure
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
 from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
+from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
@@ -312,9 +313,10 @@ class RemoteQueue:
             if isinstance(error, (ConnectionError, OSError)):
                 self._reconnect()
 
-        frames = self._retry.call(
-            _round_trip, describe=f"fetch queue {queue_index}",
-            on_retry=_redial)
+        with rt_telemetry.span("queue_fetch", task=queue_index):
+            frames = self._retry.call(
+                _round_trip, describe=f"fetch queue {queue_index}",
+                on_retry=_redial)
         items: List = []
         for kind, payload in frames:
             if kind == KIND_SENTINEL:

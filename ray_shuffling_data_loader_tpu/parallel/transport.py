@@ -26,10 +26,12 @@ from __future__ import annotations
 import socket
 import struct
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
 from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
+from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
@@ -281,7 +283,6 @@ class TcpTransport:
             if not self._closed.is_set():
                 # Fail pending/future recvs from these srcs fast (after the
                 # reconnect grace) instead of sitting out the recv timeout.
-                import time
                 now = time.monotonic()
                 with self._inbox_cv:
                     for src in srcs_seen:
@@ -315,8 +316,8 @@ class TcpTransport:
         # consumed — a caller-level retry of recv() is always safe.
         rt_faults.inject("transport_recv", epoch=tag[0], task=tag[1])
         key = (src, tag)
-        import time
-        deadline = time.monotonic() + timeout_s
+        start = time.monotonic()
+        deadline = start + timeout_s
         with self._inbox_cv:
             while key not in self._inbox:
                 if self._closed.is_set():
@@ -338,7 +339,10 @@ class TcpTransport:
                         f"host {self.host_id}: no message {tag} from host "
                         f"{src} within {timeout_s:.0f}s")
                 self._inbox_cv.wait(timeout=min(remaining, 1.0))
-            return self._inbox.pop(key)
+            payload = self._inbox.pop(key)
+        rt_telemetry.record("transport_recv", epoch=tag[0], task=tag[1],
+                            dur_s=time.monotonic() - start, src=src)
+        return payload
 
     # -- send path -----------------------------------------------------------
 
@@ -378,6 +382,7 @@ class TcpTransport:
                 s.sendall(header)
                 s.sendall(payload)
 
+        send_start = time.monotonic()
         with self._peer_locks[dest]:
             try:
                 _send_frame(sock)

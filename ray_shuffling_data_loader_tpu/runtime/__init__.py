@@ -22,10 +22,15 @@ watchdog's stats hookup):
 - :mod:`.faults` — seeded, deterministic fault injection
   (``RSDL_CHAOS_SPEC``) with named sites threaded through the hot
   paths, plus the :class:`QuarantinedFile` report vocabulary.
+- :mod:`.telemetry` — the structured-event flight recorder (ring
+  buffer, JSONL/SIGUSR1 dumps with named-thread stacks) and the online
+  per-batch bottleneck attribution every stage reports through.
+- :mod:`.metrics` — the typed counter/gauge/histogram registry with
+  Prometheus text-format exposition (file + localhost HTTP).
 """
 
 from ray_shuffling_data_loader_tpu.runtime import (  # noqa: F401
-    faults, policy, release, retry, watchdog)
+    faults, metrics, policy, release, retry, telemetry, watchdog)
 from ray_shuffling_data_loader_tpu.runtime.faults import (  # noqa: F401
     InjectedFault, QuarantinedFile)
 from ray_shuffling_data_loader_tpu.runtime.retry import (  # noqa: F401
@@ -33,6 +38,6 @@ from ray_shuffling_data_loader_tpu.runtime.retry import (  # noqa: F401
 from ray_shuffling_data_loader_tpu.runtime.watchdog import (  # noqa: F401
     StallReport, Watchdog, get_watchdog)
 
-__all__ = ["faults", "policy", "release", "retry", "watchdog",
-           "InjectedFault", "QuarantinedFile", "RetryPolicy",
+__all__ = ["faults", "metrics", "policy", "release", "retry", "telemetry",
+           "watchdog", "InjectedFault", "QuarantinedFile", "RetryPolicy",
            "StallReport", "Watchdog", "get_watchdog"]

@@ -23,7 +23,7 @@ site                 where it fires
 A chaos spec (``RSDL_CHAOS_SPEC`` env var, or :func:`install`) is a
 comma-separated list of rules::
 
-    rule := site[@rate][:epochN][:taskN|fileN][:afterN][:xN]
+    rule := site[@rate][:epochN][:taskN|fileN][:afterN][:xN][:delayN]
 
     map_read:epoch1:file2      fail epoch 1's read of file 2, once
     reduce_gather:task0        fail reducer 0's gather once per epoch
@@ -31,6 +31,9 @@ comma-separated list of rules::
     map_read:file0:x5          fail file 0's read 5 times per epoch
                                (exhausts a <5-attempt recovery budget)
     transport_send@0.01        fail ~1% of (epoch, reducer) send keys
+    reduce_gather:delay50      SLOW epoch's reduce gathers by 50 ms
+                               (once per (site, epoch, task) key; no
+                               fault raised — a latency, not a loss)
 
 Rules fire **per distinct (site, epoch, task) key**: the first matching
 call for a key raises :class:`InjectedFault`; the retry/recompute of
@@ -116,6 +119,7 @@ class ChaosRule:
     after: int = 0                # skip the key's first N matching calls
     count: int = 1                # then fail N consecutive calls per key
     rate: Optional[float] = None  # probabilistic gate per key (None = 1.0)
+    delay_ms: Optional[int] = None  # slow the call instead of failing it
     text: str = ""                # original rule text, for error messages
 
     def matches(self, site: str, epoch: Optional[int],
@@ -148,14 +152,14 @@ def _parse_rule(text: str) -> ChaosRule:
     for token in tokens[1:]:
         for prefix, field in (("epoch", "epoch"), ("file", "task"),
                               ("task", "task"), ("after", "after"),
-                              ("x", "count")):
+                              ("delay", "delay_ms"), ("x", "count")):
             if token.startswith(prefix) and token[len(prefix):].isdigit():
                 setattr(rule, field, int(token[len(prefix):]))
                 break
         else:
             raise ValueError(
                 f"bad chaos qualifier {token!r} in rule {text!r} "
-                "(expected epochN, taskN/fileN, afterN, or xN)")
+                "(expected epochN, taskN/fileN, afterN, xN, or delayN)")
     if rule.count < 1:
         raise ValueError(f"xN count must be >= 1: {text!r}")
     return rule
@@ -199,13 +203,22 @@ class FaultInjector:
             if rule.rate is not None and _stable_draw(
                     self.seed, site, epoch, task) >= rule.rate:
                 continue
-            fault = InjectedFault(site, epoch, task, rule.text)
             with self._lock:
                 self._fired.append({
                     "site": site, "epoch": epoch, "task": task,
                     "rule": rule.text, "call": seen,
                 })
-            return fault
+            if rule.delay_ms is not None:
+                # A latency fault: slow the matched call instead of
+                # failing it (bottleneck-attribution regressions inject
+                # a slow stage this way). Later rules may still fail
+                # this same call.
+                from ray_shuffling_data_loader_tpu.runtime import telemetry
+                telemetry.record(site, epoch=epoch, task=task,
+                                 fault="delay", delay_ms=rule.delay_ms)
+                time.sleep(rule.delay_ms / 1e3)
+                continue
+            return InjectedFault(site, epoch, task, rule.text)
         return None
 
     def fired(self) -> List[dict]:
@@ -274,7 +287,12 @@ def inject(site: str, epoch: Optional[int] = None,
     fault = injector.check(site, epoch, task)
     if fault is not None:
         from ray_shuffling_data_loader_tpu import stats as stats_mod
+        from ray_shuffling_data_loader_tpu.runtime import telemetry
         stats_mod.fault_stats().record_injected(site, epoch, task)
+        # kind = the fault-site name: the chaos event and the stage's
+        # own telemetry events join on (kind, epoch, task).
+        telemetry.record(site, epoch=epoch, task=task, fault="injected",
+                         rule=fault.rule)
         logger.warning("%s", fault)
         raise fault
 

@@ -1,0 +1,523 @@
+"""Typed metrics registry with Prometheus text-format exposition.
+
+The pipeline's quantitative state used to live in ad-hoc snapshot dicts
+(``stats.watchdog_stats()``, ``stats.fault_stats()``, per-phase bench
+dicts) with no shared naming, no types, and no way to observe a live
+run without instrumenting the caller. This module is the ONE registry:
+typed counters / gauges / fixed-bucket histograms behind a
+``metrics.get(name)`` API, exposable as Prometheus text format to a
+file (``write_file``) and an optional localhost HTTP endpoint
+(``start_http_server``), with a hand-rolled :func:`parse_exposition`
+so tooling (``tools/rsdl_top.py``, tests) can round-trip the output
+without a Prometheus dependency.
+
+Design constraints, in order:
+
+- **Stdlib-only** (the runtime/ contract): importable before jax or
+  pyarrow, and from the native layer without cycles.
+- **Hot-path cheap**: a counter ``inc`` is one lock round-trip; metric
+  lookup by name happens once at wiring time, not per event (call
+  sites hold the metric object).
+- **Mergeable histograms**: fixed bucket bounds shared per metric, so
+  per-epoch histograms (telemetry's bottleneck attribution) merge into
+  run totals by adding bucket counts.
+
+Label support is deliberately minimal: a metric family keyed by name
+holds one child per label set (``counter("rsdl_faults_injected_total",
+site="map_read")``); exposition renders the standard
+``name{label="value"} v`` lines.
+"""
+
+from __future__ import annotations
+
+import bisect
+import os
+import threading
+import time
+from typing import Dict, Iterable, List, Optional, Tuple
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
+    "counter", "gauge", "histogram", "get", "render", "parse_exposition",
+    "write_file", "start_http_server", "start_exporter",
+    "DEFAULT_LATENCY_BUCKETS",
+]
+
+#: Exponential-ish latency bucket upper bounds in SECONDS (``+Inf`` is
+#: implicit). Spans 100us..60s — queue waits through cold map decodes.
+DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+
+class Counter:
+    """Monotonic float counter."""
+
+    __slots__ = ("_lock", "_value")
+    kind = "counter"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        if amount < 0:
+            raise ValueError(f"counters only go up, got {amount}")
+        with self._lock:
+            self._value += amount
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Gauge:
+    """Set/inc/dec current-value metric."""
+
+    __slots__ = ("_lock", "_value")
+    kind = "gauge"
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def inc(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value += amount
+
+    def dec(self, amount: float = 1.0) -> None:
+        with self._lock:
+            self._value -= amount
+
+    def max(self, value: float) -> None:
+        """Keep the running maximum (recovery-latency style gauges)."""
+        with self._lock:
+            if value > self._value:
+                self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        with self._lock:
+            return self._value
+
+
+class Histogram:
+    """Fixed-bucket histogram with cumulative Prometheus semantics.
+
+    ``bounds`` are upper bucket bounds (``+Inf`` implicit). Internally
+    counts are per-bucket (NON-cumulative) so :meth:`merge` is a plain
+    elementwise add; exposition renders the cumulative ``_bucket`` lines
+    the text format requires. :meth:`percentile` interpolates linearly
+    within the winning bucket — the conventional estimate for
+    fixed-bucket histograms (upper-bounded by the bucket edge).
+    """
+
+    __slots__ = ("bounds", "_counts", "_sum", "_count", "_lock")
+    kind = "histogram"
+
+    def __init__(self, bounds: Iterable[float] = DEFAULT_LATENCY_BUCKETS):
+        self.bounds: Tuple[float, ...] = tuple(sorted(bounds))
+        if not self.bounds:
+            raise ValueError("histogram needs at least one bucket bound")
+        self._counts = [0] * (len(self.bounds) + 1)  # last = +Inf
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        index = bisect.bisect_left(self.bounds, value)
+        with self._lock:
+            self._counts[index] += 1
+            self._sum += value
+            self._count += 1
+
+    def merge(self, other: "Histogram") -> None:
+        """Add ``other``'s counts into this histogram (same bounds)."""
+        if other.bounds != self.bounds:
+            raise ValueError("cannot merge histograms with different "
+                             f"bounds: {self.bounds} vs {other.bounds}")
+        with other._lock:
+            counts = list(other._counts)
+            osum, ocount = other._sum, other._count
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._sum += osum
+            self._count += ocount
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def bucket_counts(self) -> List[int]:
+        """Per-bucket (non-cumulative) counts; last entry is +Inf."""
+        with self._lock:
+            return list(self._counts)
+
+    def percentile(self, q: float) -> float:
+        """Estimated q-quantile (q in [0, 1]) by linear interpolation
+        inside the winning bucket; 0.0 when empty. Values landing in the
+        +Inf bucket report the largest finite bound (a floor, explicit
+        rather than invented)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        with self._lock:
+            total = self._count
+            counts = list(self._counts)
+        if total == 0:
+            return 0.0
+        rank = q * total
+        seen = 0.0
+        for i, c in enumerate(counts):
+            if c == 0:
+                continue
+            if seen + c >= rank:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = (self.bounds[i] if i < len(self.bounds)
+                      else self.bounds[-1])
+                frac = (rank - seen) / c if c else 0.0
+                return lo + (hi - lo) * min(1.0, max(0.0, frac))
+            seen += c
+        return self.bounds[-1]
+
+
+Labels = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, str]) -> Labels:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class _Family:
+    """All children of one metric name (one per label set)."""
+
+    __slots__ = ("name", "kind", "help", "buckets", "_children", "_lock")
+
+    def __init__(self, name: str, kind: str, help_text: str,
+                 buckets: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.kind = kind
+        self.help = help_text
+        self.buckets = buckets
+        self._children: Dict[Labels, object] = {}
+        self._lock = threading.Lock()
+
+    def child(self, labels: Dict[str, str]):
+        key = _label_key(labels)
+        with self._lock:
+            metric = self._children.get(key)
+            if metric is None:
+                if self.kind == "counter":
+                    metric = Counter()
+                elif self.kind == "gauge":
+                    metric = Gauge()
+                else:
+                    metric = Histogram(self.buckets
+                                       or DEFAULT_LATENCY_BUCKETS)
+                self._children[key] = metric
+            return metric
+
+    def children(self) -> Dict[Labels, object]:
+        with self._lock:
+            return dict(self._children)
+
+
+class Registry:
+    """Name -> family index with get-or-create typed accessors."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._families: Dict[str, _Family] = {}
+
+    def _family(self, name: str, kind: str, help_text: str,
+                buckets=None) -> _Family:
+        with self._lock:
+            family = self._families.get(name)
+            if family is None:
+                family = _Family(name, kind, help_text,
+                                 tuple(buckets) if buckets else None)
+                self._families[name] = family
+            elif family.kind != kind:
+                raise ValueError(
+                    f"metric {name!r} already registered as "
+                    f"{family.kind}, requested {kind}")
+            return family
+
+    # name/help_text are positional-only so label keys may legally be
+    # "name" or "help_text" (e.g. rsdl_watchdog_stalls_total{name=...}).
+    def counter(self, name: str, help_text: str = "", /,
+                **labels: str) -> Counter:
+        return self._family(name, "counter", help_text).child(labels)
+
+    def gauge(self, name: str, help_text: str = "", /,
+              **labels: str) -> Gauge:
+        return self._family(name, "gauge", help_text).child(labels)
+
+    def histogram(self, name: str, help_text: str = "", /, buckets=None,
+                  **labels: str) -> Histogram:
+        return self._family(name, "histogram", help_text,
+                            buckets=buckets).child(labels)
+
+    def get(self, name: str, labels: Optional[Dict[str, str]] = None):
+        """Look up a registered metric: the family when ``labels`` is
+        None and the family is labeled, else the child. Returns None
+        for unknown names (observability lookups must never raise)."""
+        with self._lock:
+            family = self._families.get(name)
+        if family is None:
+            return None
+        children = family.children()
+        if labels is not None:
+            return children.get(_label_key(labels))
+        if list(children.keys()) == [()]:
+            return children[()]
+        return family
+
+    def families(self) -> Dict[str, _Family]:
+        with self._lock:
+            return dict(self._families)
+
+    # -- exposition ---------------------------------------------------------
+
+    def render(self) -> str:
+        """Prometheus text format (v0.0.4) of every registered metric."""
+        out: List[str] = []
+        for name in sorted(self._families):
+            family = self._families[name]
+            if family.help:
+                out.append(f"# HELP {name} {family.help}")
+            out.append(f"# TYPE {name} {family.kind}")
+            for labels, metric in sorted(family.children().items()):
+                label_txt = _format_labels(labels)
+                if family.kind in ("counter", "gauge"):
+                    out.append(f"{name}{label_txt} {_fmt(metric.value)}")
+                    continue
+                cumulative = 0
+                counts = metric.bucket_counts()
+                for bound, count in zip(metric.bounds, counts):
+                    cumulative += count
+                    le = _label_key(dict(labels) | {"le": _fmt(bound)})
+                    out.append(f"{name}_bucket{_format_labels(le)} "
+                               f"{cumulative}")
+                cumulative += counts[-1]
+                le = _label_key(dict(labels) | {"le": "+Inf"})
+                out.append(
+                    f"{name}_bucket{_format_labels(le)} {cumulative}")
+                out.append(f"{name}_sum{label_txt} {_fmt(metric.sum)}")
+                out.append(f"{name}_count{label_txt} {metric.count}")
+        return "\n".join(out) + "\n"
+
+
+def _fmt(value: float) -> str:
+    if value == int(value) and abs(value) < 1e15:
+        return str(int(value))
+    return repr(float(value))
+
+
+def _format_labels(labels: Labels) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in labels)
+    return "{" + inner + "}"
+
+
+def _escape(value: str) -> str:
+    return (str(value).replace("\\", r"\\").replace('"', r'\"')
+            .replace("\n", r"\n"))
+
+
+#: THE process-wide registry; the module-level helpers below proxy it.
+REGISTRY = Registry()
+
+
+def counter(name: str, help_text: str = "", /, **labels: str) -> Counter:
+    return REGISTRY.counter(name, help_text, **labels)
+
+
+def gauge(name: str, help_text: str = "", /, **labels: str) -> Gauge:
+    return REGISTRY.gauge(name, help_text, **labels)
+
+
+def histogram(name: str, help_text: str = "", /, buckets=None,
+              **labels: str) -> Histogram:
+    return REGISTRY.histogram(name, help_text, buckets=buckets, **labels)
+
+
+def get(name: str, labels: Optional[Dict[str, str]] = None):
+    return REGISTRY.get(name, labels)
+
+
+def render() -> str:
+    return REGISTRY.render()
+
+
+# ---------------------------------------------------------------------------
+# Hand-rolled exposition parser (round-trip contract for tools + tests)
+# ---------------------------------------------------------------------------
+
+
+def parse_exposition(text: str) -> Dict[str, Dict[Labels, float]]:
+    """Parse Prometheus text format into ``{name: {labels: value}}``.
+
+    Covers exactly what :meth:`Registry.render` emits (names, quoted
+    label values with escapes, int/float/``+Inf`` values); histogram
+    series appear under their ``_bucket``/``_sum``/``_count`` names.
+    Unparseable lines raise ``ValueError`` — a dump that does not
+    round-trip is a bug, not noise.
+    """
+    out: Dict[str, Dict[Labels, float]] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        name, labels, value_txt = _parse_sample(line)
+        value = float("inf") if value_txt == "+Inf" else float(value_txt)
+        out.setdefault(name, {})[labels] = value
+    return out
+
+
+def _parse_sample(line: str) -> Tuple[str, Labels, str]:
+    if "{" in line:
+        name, rest = line.split("{", 1)
+        label_txt, rest = rest.split("}", 1)
+        labels = _parse_labels(label_txt)
+        value = rest.strip()
+    else:
+        parts = line.split()
+        if len(parts) != 2:
+            raise ValueError(f"unparseable exposition line: {line!r}")
+        name, value = parts
+        labels = ()
+    if not name or not value:
+        raise ValueError(f"unparseable exposition line: {line!r}")
+    return name.strip(), labels, value
+
+
+def _parse_labels(text: str) -> Labels:
+    labels: List[Tuple[str, str]] = []
+    i = 0
+    while i < len(text):
+        eq = text.index("=", i)
+        key = text[i:eq].strip().lstrip(",").strip()
+        assert text[eq + 1] == '"', f"unquoted label value in {text!r}"
+        j = eq + 2
+        value: List[str] = []
+        while text[j] != '"':
+            if text[j] == "\\":
+                nxt = text[j + 1]
+                value.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, nxt))
+                j += 2
+                continue
+            value.append(text[j])
+            j += 1
+        labels.append((key, "".join(value)))
+        i = j + 1
+    return tuple(sorted(labels))
+
+
+# ---------------------------------------------------------------------------
+# Exposition transports: file + localhost HTTP
+# ---------------------------------------------------------------------------
+
+
+def write_file(path: str) -> str:
+    """Atomically write the current exposition to ``path``; returns it."""
+    directory = os.path.dirname(os.path.abspath(path))
+    os.makedirs(directory, exist_ok=True)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(render())
+    os.replace(tmp, path)
+    return path
+
+
+def start_http_server(port: int = 0, host: str = "127.0.0.1"):
+    """Serve ``/metrics`` on localhost; returns ``(server, port)``.
+
+    Loopback-only by default — the endpoint is an operator tool, not a
+    service surface. The server runs on a named daemon thread; call
+    ``server.shutdown()`` to stop it.
+    """
+    import http.server
+
+    class _Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):  # noqa: N802 - stdlib API
+            if self.path.rstrip("/") not in ("", "/metrics", "/healthz"):
+                self.send_response(404)
+                self.end_headers()
+                return
+            body = render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *args):  # silence per-request stderr spam
+            pass
+
+    server = http.server.ThreadingHTTPServer((host, port), _Handler)
+    thread = threading.Thread(target=server.serve_forever, daemon=True,
+                              name="rsdl-metrics-http")
+    thread.start()
+    return server, server.server_address[1]
+
+
+_exporter_lock = threading.Lock()
+_exporter_stop: Optional[threading.Event] = None
+
+
+def start_exporter(path: Optional[str] = None, port: Optional[int] = None,
+                   interval_s: float = 5.0):
+    """Periodic file exposition and/or HTTP endpoint, policy-resolvable.
+
+    With no arguments, resolves ``metrics_file`` / ``metrics_port`` /
+    ``metrics_interval_s`` from the runtime policy registry
+    (``RSDL_METRICS_FILE=/run/rsdl.prom python bench.py`` is the
+    zero-code way to watch any run with ``tools/rsdl_top.py``). Returns
+    ``(stop_event, http_port_or_None)``; idempotent — a second call
+    stops the previous file-writer loop first.
+    """
+    from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
+    if path is None:
+        path = rt_policy.resolve("metrics", "metrics_file") or None
+    if port is None:
+        port = rt_policy.resolve("metrics", "metrics_port") or None
+    interval_s = rt_policy.resolve("metrics", "metrics_interval_s",
+                                   default=interval_s)
+    global _exporter_stop
+    with _exporter_lock:
+        if _exporter_stop is not None:
+            _exporter_stop.set()
+        stop = _exporter_stop = threading.Event()
+    http_port = None
+    if port is not None:
+        _, http_port = start_http_server(int(port))
+    if path:
+        def _loop():
+            while not stop.wait(interval_s):
+                try:
+                    write_file(path)
+                except OSError:
+                    pass  # scratch volume hiccup; next tick retries
+            try:
+                write_file(path)  # final flush on stop
+            except OSError:
+                pass
+
+        write_file(path)
+        threading.Thread(target=_loop, daemon=True,
+                         name="rsdl-metrics-export").start()
+    return stop, http_port

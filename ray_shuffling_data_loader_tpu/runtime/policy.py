@@ -77,6 +77,21 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     "retry_initial_backoff_s": (0.05, float),
     "retry_max_backoff_s": (2.0, float),
     "retry_deadline_s": (0.0, float),
+    # Telemetry spine (runtime/telemetry.py): flight-recorder on/off,
+    # ring capacity (events), and where escalation/SIGUSR1 dumps land
+    # ("" = the system temp dir).
+    "telemetry": (True, _parse_bool),
+    "telemetry_capacity": (4096, int),
+    "telemetry_dump_dir": ("", str),
+    # Batch-wait share of wall clock above which the per-epoch verdict
+    # names a producer stage instead of train_step (the <=10% stall
+    # contract's mirror image).
+    "bottleneck_stall_threshold_pct": (10.0, float),
+    # Metrics exposition (runtime/metrics.py): Prometheus text file path
+    # ("" = off), localhost HTTP port (0 = off), file rewrite cadence.
+    "metrics_file": ("", str),
+    "metrics_port": (0, int),
+    "metrics_interval_s": (5.0, float),
     # What shuffle_map does with a corrupt/unreadable input file after
     # read retries are exhausted: "raise" (fail the map task; lineage
     # recovery then retries it, and only exhausted recovery poisons the

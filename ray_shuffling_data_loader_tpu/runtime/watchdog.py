@@ -142,6 +142,19 @@ class Watchdog:
                     timestamp=time.time())
                 w.report = report
                 stats_mod.watchdog_stats().record_stall(report)
+                if escalation == 2:
+                    # The stall persisted past a second deadline: dump
+                    # the flight recorder + thread stacks ONCE per watch
+                    # while the stuck call is still stuck — the forensic
+                    # record a post-mortem cannot reconstruct.
+                    from ray_shuffling_data_loader_tpu.runtime import (
+                        telemetry)
+                    try:
+                        telemetry.dump(
+                            reason=f"watchdog escalation: {w.name}")
+                    except Exception:  # noqa: BLE001 - supervision survives
+                        logger.exception(
+                            "watchdog telemetry dump failed for %s", w.name)
                 log = logger.warning if escalation == 1 else logger.error
                 log("watchdog: %s has run %.2fs (deadline %.2fs, "
                     "escalation %d)%s", report.name, report.waited_s,

@@ -44,6 +44,7 @@ from ray_shuffling_data_loader_tpu import stats as stats_mod
 from ray_shuffling_data_loader_tpu.ops import partition as ops
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
 from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
+from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.utils import fileio
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
@@ -551,6 +552,11 @@ def shuffle_map(filename: str,
             from ray_shuffling_data_loader_tpu import native
             native.account_table(table)
         end_read = timeit.default_timer()
+        # Flight-recorder stage event: kind reuses the fault-site name,
+        # so a chaos run's map_read faults join this event by
+        # (kind, epoch, task).
+        rt_telemetry.record("map_read", epoch=epoch, task=file_index,
+                            dur_s=end_read - start)
         rng = ops.map_rng(seed, epoch, file_index)
         assignments = ops.assign_reducers(table.num_rows, num_reducers, rng)
         index_parts = ops.partition_indices(assignments, num_reducers)
@@ -868,21 +874,29 @@ def _reduce_task(reduce_index: int, seed: int, epoch: int,
     """
 
     def _gather_and_shuffle() -> pa.Table:
-        rt_faults.inject("reduce_gather", epoch=epoch, task=reduce_index)
-        chunks = []
-        for file_index, ref in enumerate(map_refs):
-            try:
-                shard = ref.result()
-            except Exception as e:  # noqa: BLE001 - recovered from lineage
-                if lineage is None:
-                    raise
-                shard = lineage.recover(file_index, e)
-            if isinstance(shard, rt_faults.QuarantinedFile):
-                continue  # dropped file: shuffle the surviving inputs
-            chunks.append(shard[reduce_index])
-        return shuffle_reduce(reduce_index, seed, epoch, chunks,
-                              stats_collector, reduce_transform,
-                              gather_threads)
+        # The telemetry span covers the WHOLE reduce task body (fault
+        # site, ref gather, fused shuffle) — that is the unit the
+        # bottleneck attribution bills to the "reduce" stage, and the
+        # unit a reduce_gather chaos rule (fail or delayN) perturbs, so
+        # the two correlate by (kind, epoch, task).
+        with rt_telemetry.span("reduce_gather", epoch=epoch,
+                               task=reduce_index):
+            rt_faults.inject("reduce_gather", epoch=epoch,
+                             task=reduce_index)
+            chunks = []
+            for file_index, ref in enumerate(map_refs):
+                try:
+                    shard = ref.result()
+                except Exception as e:  # noqa: BLE001 - lineage recovers
+                    if lineage is None:
+                        raise
+                    shard = lineage.recover(file_index, e)
+                if isinstance(shard, rt_faults.QuarantinedFile):
+                    continue  # dropped file: shuffle the surviving inputs
+                chunks.append(shard[reduce_index])
+            return shuffle_reduce(reduce_index, seed, epoch, chunks,
+                                  stats_collector, reduce_transform,
+                                  gather_threads)
 
     if retry_policy is None:
         shuffled = _gather_and_shuffle()

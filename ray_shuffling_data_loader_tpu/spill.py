@@ -82,7 +82,7 @@ class SpilledTable:
                 # no second copy; the in-memory table was dropped when the
                 # handle replaced it).
                 rt_faults.inject("spill_read")
-                with trace_span("spill_load"):
+                with trace_span("spill_load", kind="spill_read"):
                     with pa.memory_map(self._path) as source:
                         self._table = pa.ipc.open_file(source).read_all()
                 _unlink_quiet(self._path)
@@ -132,8 +132,13 @@ class SpillManager:
             path = os.path.join(self._dir, f"reduce_{self._seq}.arrow")
             self._seq += 1
         try:
-            rt_faults.inject("spill_write", task=self._seq - 1)
-            with trace_span("spill_write"):
+            # Fault site INSIDE the telemetry span: an injected write
+            # failure still records a spill_write event with this task
+            # key, so chaos and telemetry stay joinable even when the
+            # write degrades to in-memory.
+            with trace_span("spill_write", kind="spill_write",
+                            task=self._seq - 1):
+                rt_faults.inject("spill_write", task=self._seq - 1)
                 with pa.OSFile(path, "wb") as sink:
                     with pa.ipc.new_file(sink, table.schema) as writer:
                         writer.write_table(table)
@@ -154,6 +159,11 @@ class SpillManager:
         with _totals_lock:
             _total_spill_count += 1
             _total_spilled_bytes += size
+        from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+        rt_metrics.counter("rsdl_spills_total",
+                           "reducer outputs spilled to disk").inc()
+        rt_metrics.counter("rsdl_spilled_bytes_total",
+                           "bytes of reducer output spilled").inc(size)
         return SpilledTable(path, table.num_rows, self)
 
     def report(self) -> None:

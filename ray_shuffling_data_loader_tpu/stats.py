@@ -11,12 +11,22 @@ Report schema: the trial CSV and epoch CSV column sets reproduce the
 reference's exactly (reference: stats.py:305-355,468-505) so downstream
 tooling reads either; the trial CSV additionally APPENDS the
 watchdog/stall columns (``watchdog_events``, ``stall_escalations``,
-``fallbacks_engaged``) and the fault/recovery columns
+``fallbacks_engaged``), the fault/recovery columns
 (``faults_injected``, ``fault_retries``, ``fault_recomputes``,
-``fault_quarantines``, ``fault_recoveries_exhausted``) — process totals
-at write time — which position-indexed reference tooling never sees. Memory utilization sampling replaces the raylet gRPC
-store probe (reference: stats.py:598-632) with host RSS + native buffer-pool
-bytes + optional TPU HBM via ``device.memory_stats()``.
+``fault_quarantines``, ``fault_recoveries_exhausted``) and the
+telemetry bottleneck columns (``bottleneck_stage``,
+``telemetry_stall_pct``, per-stage ``p95_<stage>_ms`` — computed by
+runtime/telemetry.py from flight-recorder events) — process totals at
+write time — which position-indexed reference tooling never sees.
+Memory utilization sampling replaces the raylet gRPC store probe
+(reference: stats.py:598-632) with host RSS + native buffer-pool bytes
++ optional TPU HBM via ``device.memory_stats()``.
+
+The watchdog/fault recorders below no longer own private integer
+counters: their counts ARE typed counters in the runtime metrics
+registry (runtime/metrics.py), so the Prometheus exposition, the bench
+JSON, and these snapshot dicts read the same cells — ``snapshot()`` is
+now a *reader* of the registry, kept for its stable dict schema.
 """
 
 from __future__ import annotations
@@ -32,6 +42,8 @@ from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.utils import fileio
 from ray_shuffling_data_loader_tpu.utils.humanize import (
     human_readable_big_num, human_readable_size)
@@ -355,10 +367,17 @@ class WatchdogStats:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._events = 0          # every recorded deadline miss
-        self._escalations = 0     # misses beyond a watch's first
-        self._fallbacks = 0       # automatic degradations engaged
-        self._by_name: Dict[str, int] = {}
+        # Counts live in the metrics registry (one set of cells per
+        # process — a second WatchdogStats instance shares them); only
+        # the recent-stall diagnostic ring is per-instance.
+        self._events = rt_metrics.counter(
+            "rsdl_watchdog_events_total", "watchdog deadline misses")
+        self._escalations = rt_metrics.counter(
+            "rsdl_watchdog_escalations_total",
+            "stalls persisting past further deadline multiples")
+        self._fallbacks = rt_metrics.counter(
+            "rsdl_watchdog_fallbacks_total",
+            "automatic degradations engaged")
         self._recent: List[Dict[str, Any]] = []
 
     def record_stall(self, report) -> None:
@@ -372,18 +391,24 @@ class WatchdogStats:
             "detail": report.detail,
             "timestamp": float(report.timestamp),
         }
+        self._events.inc()
+        if report.escalation > 1:
+            self._escalations.inc()
+        rt_metrics.counter("rsdl_watchdog_stalls_total",
+                           "deadline misses by watch name",
+                           name=report.name).inc()
+        rt_telemetry.record("watchdog_stall", name=report.name,
+                            escalation=int(report.escalation),
+                            waited_s=float(report.waited_s),
+                            detail=report.detail)
         with self._lock:
-            self._events += 1
-            if report.escalation > 1:
-                self._escalations += 1
-            self._by_name[report.name] = (
-                self._by_name.get(report.name, 0) + 1)
             self._recent.append(entry)
             del self._recent[:-self._RECENT]
 
     def record_fallback(self, component: str, reason: str) -> None:
+        self._fallbacks.inc()
+        rt_telemetry.record("fallback", component=component, reason=reason)
         with self._lock:
-            self._fallbacks += 1
             self._recent.append({
                 "name": f"{component}:fallback",
                 "detail": reason,
@@ -392,14 +417,20 @@ class WatchdogStats:
             del self._recent[:-self._RECENT]
 
     def snapshot(self) -> Dict[str, Any]:
+        by_name: Dict[str, int] = {}
+        family = rt_metrics.get("rsdl_watchdog_stalls_total")
+        if family is not None and hasattr(family, "children"):
+            for labels, metric in family.children().items():
+                by_name[dict(labels).get("name", "?")] = int(metric.value)
         with self._lock:
-            return {
-                "watchdog_events": self._events,
-                "stall_escalations": self._escalations,
-                "fallbacks_engaged": self._fallbacks,
-                "stalls_by_name": dict(self._by_name),
-                "recent_stalls": list(self._recent),
-            }
+            recent = list(self._recent)
+        return {
+            "watchdog_events": int(self._events.value),
+            "stall_escalations": int(self._escalations.value),
+            "fallbacks_engaged": int(self._fallbacks.value),
+            "stalls_by_name": by_name,
+            "recent_stalls": recent,
+        }
 
 
 _watchdog_stats = WatchdogStats()
@@ -433,57 +464,78 @@ class FaultStats:
 
     def __init__(self):
         self._lock = threading.Lock()
-        self._injected = 0
-        self._retries = 0
-        self._recomputes = 0
-        self._quarantines = 0
-        self._exhausted = 0
-        self._recovery_latency_total_s = 0.0
-        self._recovery_latency_max_s = 0.0
-        self._by_site: Dict[str, int] = {}
+        # Counts live in the metrics registry (shared per process, like
+        # WatchdogStats); the quarantine report ring is per-instance.
+        self._injected = rt_metrics.counter(
+            "rsdl_faults_injected_total", "chaos faults fired")
+        self._retries = rt_metrics.counter(
+            "rsdl_fault_retries_total", "RetryPolicy backoffs taken")
+        self._recomputes = rt_metrics.counter(
+            "rsdl_fault_recomputes_total",
+            "tasks re-executed successfully after a failure")
+        self._quarantines = rt_metrics.counter(
+            "rsdl_fault_quarantines_total",
+            "input files dropped by on_bad_file='skip'")
+        self._exhausted = rt_metrics.counter(
+            "rsdl_fault_exhausted_total",
+            "recoveries that ran out of attempts")
+        self._recovery_latency = rt_metrics.histogram(
+            "rsdl_fault_recovery_seconds", "recompute/recovery latency")
+        self._recovery_latency_max = rt_metrics.gauge(
+            "rsdl_fault_recovery_max_seconds",
+            "largest single recovery latency")
         self._recent_quarantines: List[Dict[str, Any]] = []
 
     def record_injected(self, site: str, epoch=None, task=None) -> None:
-        with self._lock:
-            self._injected += 1
-            self._by_site[site] = self._by_site.get(site, 0) + 1
+        self._injected.inc()
+        rt_metrics.counter("rsdl_faults_injected_by_site_total",
+                           "chaos faults fired by site", site=site).inc()
 
     def record_retry(self, component: str) -> None:
-        with self._lock:
-            self._retries += 1
+        self._retries.inc()
+        rt_telemetry.record("fault_retry", component=component)
 
     def record_recompute(self, component: str, latency_s: float) -> None:
-        with self._lock:
-            self._recomputes += 1
-            self._recovery_latency_total_s += latency_s
-            self._recovery_latency_max_s = max(
-                self._recovery_latency_max_s, latency_s)
+        self._recomputes.inc()
+        self._recovery_latency.observe(latency_s)
+        self._recovery_latency_max.max(latency_s)
+        rt_telemetry.record("fault_recompute", component=component,
+                            latency_s=latency_s)
 
     def record_quarantine(self, report) -> None:
         """``report`` is a ``runtime.faults.QuarantinedFile`` (duck-typed:
         ``as_dict()``)."""
+        self._quarantines.inc()
+        rt_telemetry.record("fault_quarantine",
+                            epoch=getattr(report, "epoch", None),
+                            task=getattr(report, "file_index", None))
         with self._lock:
-            self._quarantines += 1
             self._recent_quarantines.append(report.as_dict())
             del self._recent_quarantines[:-self._RECENT]
 
     def record_exhausted(self, component: str) -> None:
-        with self._lock:
-            self._exhausted += 1
+        self._exhausted.inc()
+        rt_telemetry.record("fault_exhausted", component=component)
 
     def snapshot(self) -> Dict[str, Any]:
+        by_site: Dict[str, int] = {}
+        family = rt_metrics.get("rsdl_faults_injected_by_site_total")
+        if family is not None and hasattr(family, "children"):
+            for labels, metric in family.children().items():
+                by_site[dict(labels).get("site", "?")] = int(metric.value)
         with self._lock:
-            return {
-                "injected": self._injected,
-                "retries": self._retries,
-                "recomputes": self._recomputes,
-                "quarantines": self._quarantines,
-                "exhausted": self._exhausted,
-                "recovery_latency_total_s": self._recovery_latency_total_s,
-                "recovery_latency_max_s": self._recovery_latency_max_s,
-                "injected_by_site": dict(self._by_site),
-                "recent_quarantines": list(self._recent_quarantines),
-            }
+            recent = list(self._recent_quarantines)
+        return {
+            "injected": int(self._injected.value),
+            "retries": int(self._retries.value),
+            "recomputes": int(self._recomputes.value),
+            "quarantines": int(self._quarantines.value),
+            "exhausted": int(self._exhausted.value),
+            "recovery_latency_total_s": self._recovery_latency.sum,
+            "recovery_latency_max_s": self._recovery_latency_max.value,
+            "injected_by_site": by_site,
+            "recent_quarantines": recent,
+        }
 
     def __getitem__(self, key: str):
         """Mapping-style access to the current totals
@@ -607,6 +659,13 @@ TRIAL_FIELDNAMES = [
     # Fault/recovery totals (fault_stats(); process totals at write time).
     "faults_injected", "fault_retries", "fault_recomputes",
     "fault_quarantines", "fault_recoveries_exhausted",
+    # Telemetry bottleneck verdict (runtime/telemetry.py run summary at
+    # write time: stage with the largest work share when the consumer's
+    # batch-wait share exceeds the stall threshold, else train_step).
+    "bottleneck_stage", "telemetry_stall_pct",
+    "p95_map_read_ms", "p95_reduce_ms", "p95_queue_wait_ms",
+    "p95_fetch_ms", "p95_convert_ms", "p95_device_transfer_ms",
+    "p95_train_step_ms",
 ]
 
 EPOCH_FIELDNAMES = [
@@ -687,6 +746,8 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
 
     wd = watchdog_stats().snapshot()
     fs = fault_stats().snapshot()
+    verdict = rt_telemetry.attribution().run_summary() or {}
+    verdict_stages = verdict.get("stages", {})
 
     path, header = _open_report("trial")
     logger.info("Writing trial stats to %s", path)
@@ -705,6 +766,11 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
             row["fault_recomputes"] = fs["recomputes"]
             row["fault_quarantines"] = fs["quarantines"]
             row["fault_recoveries_exhausted"] = fs["exhausted"]
+            row["bottleneck_stage"] = verdict.get("bottleneck_stage", "")
+            row["telemetry_stall_pct"] = verdict.get("stall_pct", 0.0)
+            for stage in rt_telemetry.STAGES:
+                row[f"p95_{stage}_ms"] = verdict_stages.get(
+                    stage, {}).get("p95_ms", 0.0)
             row["duration"] = stats.duration
             row_tp = num_epochs * num_rows / stats.duration
             row["row_throughput"] = row_tp

@@ -36,10 +36,28 @@ def _get_trace_annotation():
 
 
 @contextlib.contextmanager
-def trace_span(name: str) -> Iterator[None]:
+def trace_span(name: str, kind: Optional[str] = None,
+               epoch: Optional[int] = None, task: Optional[int] = None,
+               batch: Optional[int] = None) -> Iterator[None]:
     """Named host span, visible in captured profiler traces. No-op cheap
-    when no trace is active; safe to call from worker threads."""
+    when no trace is active; safe to call from worker threads.
+
+    With ``kind`` set, the span is ALSO recorded as a structured
+    flight-recorder event (runtime/telemetry.py) carrying the given
+    correlation ids — one annotation, two consumers: the XLA profiler
+    timeline and the online bottleneck attribution.
+    """
     annotation = _get_trace_annotation()
+    if kind is not None:
+        from ray_shuffling_data_loader_tpu.runtime import telemetry
+        if not annotation:
+            with telemetry.span(kind, epoch=epoch, task=task, batch=batch):
+                yield
+            return
+        with telemetry.span(kind, epoch=epoch, task=task, batch=batch):
+            with annotation(name):
+                yield
+        return
     if not annotation:
         yield
         return

@@ -276,6 +276,39 @@ RETRY_OK = """
                 return
 """
 
+WALLCLOCK_DIRECT_BAD = """
+    import time
+
+    def wait_for(predicate, timeout_s):
+        deadline = time.time() + timeout_s  # deadline on wall clock
+        while not predicate():
+            if time.time() - deadline > 0:
+                return False
+        return True
+"""
+
+WALLCLOCK_VAR_BAD = """
+    import time
+
+    def measure(fn):
+        start = time.time()
+        fn()
+        return time.monotonic() - start  # mixes clocks via the variable
+"""
+
+WALLCLOCK_OK = """
+    import time
+
+    def sample():
+        # serialized timestamp, no interval arithmetic: not flagged
+        return {"timestamp": time.time()}
+
+    def measure(fn):
+        start = time.monotonic()
+        fn()
+        return time.monotonic() - start
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -291,6 +324,8 @@ CASES = [
     ("gc-collect-in-wait", GC_WAIT_BAD, GC_WAIT_OK, {}),
     ("unbounded-retry", RETRY_WHILE_BAD, RETRY_OK, {}),
     ("unbounded-retry", RETRY_FIXED_SLEEP_BAD, RETRY_OK, {}),
+    ("wallclock-interval", WALLCLOCK_DIRECT_BAD, WALLCLOCK_OK, {}),
+    ("wallclock-interval", WALLCLOCK_VAR_BAD, WALLCLOCK_OK, {}),
 ]
 
 
