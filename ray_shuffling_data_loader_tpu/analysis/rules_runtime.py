@@ -17,6 +17,16 @@ and a fixed-interval ``time.sleep(N)`` retry re-hits a recovering
 resource in lockstep with every other retrier. Both shapes must route
 through :class:`runtime.retry.RetryPolicy` (bounded attempts,
 decorrelated jitter, deadline, fault-stats accounting).
+
+``socket-op-no-timeout`` guards the cross-process plane: a blocking
+``recv``/``accept``/``connect`` on a socket with no timeout configured
+waits forever on a wedged peer — past the watchdog, past the lease
+sweeper, unkillable except by process death. Every socket must either
+be created with a timeout (``create_connection(addr, timeout=...)``)
+or have ``settimeout`` called on it; ``settimeout(None)`` counts as
+configured — an *explicit* infinite wait is a reviewed decision, the
+silent default is the bug (PR-5 satellite: queue timeouts now resolve
+through ``RSDL_QUEUE_TIMEOUT``).
 """
 
 from __future__ import annotations
@@ -161,3 +171,85 @@ class UnboundedRetryRule(Rule):
                         "recovering resource in lockstep with every other "
                         "retrier; use runtime.retry.RetryPolicy "
                         "(exponential backoff with decorrelated jitter)")
+
+
+#: Socket methods that block indefinitely without a configured timeout.
+_BLOCKING_SOCKET_OPS = {"recv", "recv_into", "recvfrom", "accept",
+                        "connect"}
+#: Constructors whose result is a socket object.
+_SOCKET_CONSTRUCTORS = {"socket.socket", "socket.create_connection"}
+
+
+def _call_has_timeout(call: ast.Call) -> bool:
+    """``create_connection(addr, timeout)`` / ``timeout=`` counts as a
+    timeout configured at construction."""
+    if any(kw.arg == "timeout" for kw in call.keywords):
+        return True
+    tail = dotted_name(call.func).rsplit(".", 1)[-1]
+    return tail == "create_connection" and len(call.args) >= 2
+
+
+def _target_names(target: ast.AST):
+    """Dotted names bound by an assignment target (plain or the first
+    element of a tuple unpack — ``conn, peer = listener.accept()``)."""
+    if isinstance(target, (ast.Name, ast.Attribute)):
+        name = dotted_name(target)
+        if "?" not in name:
+            yield name
+    elif isinstance(target, (ast.Tuple, ast.List)) and target.elts:
+        yield from _target_names(target.elts[0])
+
+
+@register
+class SocketOpNoTimeoutRule(Rule):
+    id = "socket-op-no-timeout"
+    category = "runtime"
+    description = ("blocking socket `recv`/`accept`/`connect` on a socket "
+                   "with no timeout configured — waits forever on a wedged "
+                   "peer, past the watchdog and the lease sweeper; call "
+                   "`settimeout` (policy key RSDL_QUEUE_TIMEOUT for the "
+                   "queue plane) or create with `timeout=`")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        tracked: Set[str] = set()      # names known to hold sockets
+        configured: Set[str] = set()   # ... with a timeout configured
+        # Pass 1: collect socket bindings and settimeout calls (order-
+        # independent on purpose: configuration in __init__ covers ops
+        # in methods defined earlier in the class body).
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                call = node.value
+                callee = dotted_name(call.func)
+                is_ctor = callee in _SOCKET_CONSTRUCTORS
+                is_accept = callee.rsplit(".", 1)[-1] == "accept"
+                if not (is_ctor or is_accept):
+                    continue
+                for name in (n for t in node.targets
+                             for n in _target_names(t)):
+                    tracked.add(name)
+                    if is_ctor and _call_has_timeout(call):
+                        configured.add(name)
+            elif isinstance(node, ast.Call):
+                callee = dotted_name(node.func)
+                if callee.rsplit(".", 1)[-1] == "settimeout":
+                    configured.add(callee.rsplit(".settimeout", 1)[0])
+        # Pass 2: flag blocking ops on tracked-but-unconfigured names.
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted_name(node.func)
+            base, _, op = callee.rpartition(".")
+            if op not in _BLOCKING_SOCKET_OPS or not base:
+                continue
+            if base in tracked and base not in configured:
+                yield ctx.violation(
+                    self, node,
+                    f"blocking `{op}` on socket `{base}` with no timeout "
+                    f"configured waits forever on a wedged peer (past the "
+                    f"watchdog and the lease sweeper); call "
+                    f"`{base}.settimeout(...)` — policy-resolved, e.g. "
+                    f"RSDL_QUEUE_TIMEOUT — or construct it with "
+                    f"`timeout=`; `settimeout(None)` is accepted as an "
+                    f"explicit, reviewed infinite wait")

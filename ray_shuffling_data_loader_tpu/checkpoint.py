@@ -21,7 +21,9 @@ import dataclasses
 import json
 import os
 import tempfile
-from typing import Iterator, Optional
+import threading
+import zlib
+from typing import Dict, Iterator, Optional
 
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
@@ -76,6 +78,135 @@ class LoaderCheckpoint:
         return cls(**data)
 
 
+@dataclasses.dataclass
+class WatermarkEntry:
+    """Latest journaled state of one queue index: the last acked frame
+    seq, cumulative table rows delivered through it, and whether the
+    epoch-end sentinel itself has been acked."""
+
+    seq: int
+    rows: int
+    done: bool = False
+
+
+class WatermarkJournal:
+    """Crc'd append-only journal of per-queue delivered watermarks.
+
+    The queue server (multiqueue_service.QueueServer) appends a record
+    every time a consumer's ack watermark advances; a restarted server
+    process loads the journal and regenerates ONLY the undelivered
+    remainder from the deterministic shuffle lineage. Each line is a
+    JSON record whose ``crc`` field covers the canonical encoding of the
+    other fields — a torn tail (the server died mid-write) is skipped on
+    load, never misread. :meth:`compact` rewrites the latest-per-queue
+    state with the same atomic tmp + fsync + rename discipline as
+    :class:`LoaderCheckpoint`.
+    """
+
+    def __init__(self, path: str):
+        self._path = path
+        self._lock = threading.Lock()
+        self._file = None
+
+    @staticmethod
+    def _encode(entry: dict) -> str:
+        body = json.dumps(entry, sort_keys=True, separators=(",", ":"))
+        crc = zlib.crc32(body.encode()) & 0xFFFFFFFF
+        return json.dumps({"crc": crc, "entry": entry}, sort_keys=True,
+                          separators=(",", ":"))
+
+    def record(self, queue_index: int, seq: int, rows: int,
+               done: bool = False) -> None:
+        """Append one watermark advance; flushed + fsync'd so a
+        ``kill -9`` loses at most acks the consumer will simply re-see
+        (replay is idempotent by seq)."""
+        entry = {"q": int(queue_index), "seq": int(seq),
+                 "rows": int(rows), "done": bool(done)}
+        line = self._encode(entry) + "\n"
+        with self._lock:
+            if self._file is None:
+                directory = os.path.dirname(os.path.abspath(self._path))
+                os.makedirs(directory, exist_ok=True)
+                self._file = open(self._path, "a", encoding="utf-8")
+            self._file.write(line)
+            self._file.flush()
+            os.fsync(self._file.fileno())
+
+    @classmethod
+    def load(cls, path: str) -> Dict[int, WatermarkEntry]:
+        """Latest watermark per queue index; lines with a bad/missing
+        CRC (torn tail) are skipped with a warning."""
+        state: Dict[int, WatermarkEntry] = {}
+        if not os.path.exists(path):
+            return state
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                    entry = record["entry"]
+                    body = json.dumps(entry, sort_keys=True,
+                                      separators=(",", ":"))
+                    if zlib.crc32(body.encode()) & 0xFFFFFFFF != \
+                            record["crc"]:
+                        raise ValueError("crc mismatch")
+                    queue_index = int(entry["q"])
+                except (ValueError, KeyError, TypeError) as e:
+                    logger.warning(
+                        "watermark journal %s line %d unreadable (%s); "
+                        "skipping (torn tail from a crash is expected)",
+                        path, lineno, e)
+                    continue
+                previous = state.get(queue_index)
+                if previous is None or entry["seq"] >= previous.seq:
+                    state[queue_index] = WatermarkEntry(
+                        seq=int(entry["seq"]), rows=int(entry["rows"]),
+                        done=bool(entry["done"]))
+        return state
+
+    def compact(self) -> None:
+        """Rewrite the journal as one latest record per queue, atomic
+        tmp + fsync + rename (the LoaderCheckpoint discipline) — run at
+        server restart so the append-only file cannot grow unboundedly
+        across crash/recovery cycles."""
+        state = self.load(self._path)
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            directory = os.path.dirname(os.path.abspath(self._path))
+            os.makedirs(directory, exist_ok=True)
+            fd, tmp_path = tempfile.mkstemp(dir=directory, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    for queue_index in sorted(state):
+                        entry = state[queue_index]
+                        f.write(self._encode(
+                            {"q": queue_index, "seq": entry.seq,
+                             "rows": entry.rows, "done": entry.done})
+                            + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp_path, self._path)
+                dir_fd = os.open(directory, os.O_RDONLY)
+                try:
+                    os.fsync(dir_fd)
+                finally:
+                    os.close(dir_fd)
+            except BaseException:
+                if os.path.exists(tmp_path):
+                    os.remove(tmp_path)
+                raise
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
 def resume_iterator(dataset,
                     checkpoint: LoaderCheckpoint,
                     checkpoint_path: Optional[str] = None,
@@ -115,6 +246,14 @@ def resume_iterator(dataset,
     def _maybe_save():
         if checkpoint_path is not None:
             checkpoint.save(checkpoint_path)
+            # Replaying-queue integration: once the position is durable,
+            # release the server's replay buffer up to it. A dataset fed
+            # by a manual-ack RemoteQueue forwards this to the queue's
+            # commit(); everything since the previous save stays
+            # replayable for a crash-resumed trainer.
+            commit = getattr(dataset, "commit_consumed", None)
+            if commit is not None:
+                commit()
 
     for epoch in range(checkpoint.epoch, checkpoint.num_epochs):
         skip = checkpoint.batches_consumed if epoch == checkpoint.epoch else 0

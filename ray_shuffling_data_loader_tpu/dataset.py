@@ -307,16 +307,28 @@ class ShufflingDataset:
                 "the beginning of each epoch, before iterating over this "
                 "dataset (e.g. via enumerate(ds)).")
 
-        to_skip = self._skip_batches * self._batch_size  # rows, not batches
+        skip_rows = self._skip_batches * self._batch_size  # rows, not batches
+        to_skip = skip_rows
         self._skip_batches = 0
         queue_idx = self._epoch * self._num_trainers + self._rank
+        # Positioned gets (multiqueue_service.RemoteQueue) return the
+        # table's absolute row offset in the queue's stream. A replaying
+        # queue legally restarts the stream mid-epoch (at the consumer's
+        # last durable watermark), so a checkpoint-resume skip must be
+        # absolute — "drop rows before position skip_rows" — not a count
+        # of rows seen on THIS connection.
+        get_positioned = getattr(self._batch_queue, "get_positioned", None)
         while True:
             # Epoch-tagged queue wait: this is where a consumer blocks
             # when the shuffle cannot keep up — the "queue_wait" stage
             # of the bottleneck decomposition (the queue layer's own
             # queue_get events have no epoch identity).
             wait_start = timeit.default_timer()
-            ref = self._batch_queue.get(queue_idx, block=True)
+            if get_positioned is not None:
+                ref, row_offset = get_positioned(queue_idx)
+            else:
+                ref = self._batch_queue.get(queue_idx, block=True)
+                row_offset = None
             rt_telemetry.record(
                 "queue_wait", epoch=self._epoch, task=queue_idx,
                 dur_s=timeit.default_timer() - wait_start)
@@ -333,8 +345,11 @@ class ShufflingDataset:
             # it survives the resume skip: a fully-skipped handle is
             # dropped unloaded (its finalizer unlinks the file).
             raw = ref.result() if hasattr(ref, "result") else ref
+            if row_offset is not None:
+                to_skip = max(0, skip_rows - row_offset)
             if to_skip and raw.num_rows <= to_skip:
-                to_skip -= raw.num_rows
+                if row_offset is None:
+                    to_skip -= raw.num_rows
                 continue
             table: pa.Table = spill.unwrap(raw)
             if to_skip:
@@ -362,6 +377,15 @@ class ShufflingDataset:
     def __iter__(self) -> Iterator[pa.Table]:
         return slice_batches(self.iter_tables(), self._batch_size,
                              self._drop_last)
+
+    def commit_consumed(self) -> None:
+        """Tell a manual-ack batch queue that consumption so far is
+        durable (``checkpoint.resume_iterator`` calls this after every
+        checkpoint save). No-op for in-process queues and auto-ack
+        remote queues."""
+        commit = getattr(self._batch_queue, "commit", None)
+        if commit is not None:
+            commit()
 
     def shutdown(self) -> None:
         """Release the named queue if this dataset created it. Idempotent.

@@ -27,43 +27,115 @@ background prefetcher keeps one batched request in flight — so steady
 state pays ~one round trip per ``max_items`` tables and overlaps the
 wire time with consumption.
 
-Wire format, little-endian: requests are ``(u8 op=1, u32 queue_idx,
-u32 max_items)``; responses are ``(u32 count)`` followed by ``count``
-frames of ``(u8 kind, u64 length, payload)`` with kind 0=table IPC
-stream, 1=epoch-end sentinel, 2=shuffle-failure (payload = error text).
+Wire format **v2** (process-crash recovery), little-endian. Requests are
+a fixed 14-byte struct ``(u8 op, u8 flags, u32 a, u32 b, u32 c)``:
+
+====================  =====================================================
+op                    fields
+====================  =====================================================
+``1 OP_GET_BATCH``    a=queue_idx, b=max_items, c=ack watermark (the last
+                      seq the consumer durably consumed for this queue;
+                      ``0xFFFFFFFF`` = none). ``flags & FLAG_RESUME``:
+                      first GET on a (re)connected socket — the server
+                      rewinds its send cursor to the ack watermark and
+                      replays exactly the unacked suffix.
+``2 OP_HELLO``        a|b<<32 = 64-bit consumer id (lease identity; sent
+                      once per connection, survives reconnects).
+``3 OP_HEARTBEAT``    consumer-side lease keep-alive between GETs.
+``4 OP_NACK``         a=queue_idx, b=seq of a frame whose CRC failed; the
+                      server rewinds its send cursor to ``seq - 1`` and
+                      re-sends from its replay buffer.
+====================  =====================================================
+
+Responses are ``(u32 count)`` followed by ``count`` frames of
+``(u8 kind, u32 epoch, u32 seq, u32 crc32, u64 row_offset, u64 length,
+payload)`` with kind 0=table IPC stream, 1=epoch-end sentinel,
+2=shuffle-failure (payload = error text). ``seq`` is a per-queue
+monotonic frame number (stable across server restarts — restored from
+the delivered-watermark journal); ``crc32`` covers the payload bytes
+(zlib CRC-32), so corruption anywhere on the wire or in a replayed
+buffer is detected at the consumer and NACK'd; ``row_offset`` is the
+cumulative row count of all preceding table frames in this queue's
+stream, which lets a checkpoint-resuming consumer skip already-consumed
+rows *absolutely* even when the stream replays from mid-epoch.
+
+The **v1** format (pre-recovery, for archaeology): requests were
+``(u8 op=1, u32 queue_idx, u32 max_items)`` and frames were bare
+``(u8 kind, u64 length, payload)`` — no identity, no integrity, no ack:
+the server popped items destructively before streaming them, so a
+connection reset mid-response silently lost batches, and a killed
+server process lost every queued table.
+
+Recovery semantics built on v2 (see ``examples/fault_tolerance.md`` for
+the full process-failure matrix):
+
+- The server keeps a bounded per-queue **replay buffer** of unacked
+  frames; acks piggyback on every GET and are journaled
+  (``checkpoint.WatermarkJournal``), so a connection reset at ANY byte
+  of a response is recovered by reconnect + FLAG_RESUME — exactly-once
+  delivery, asserted bit-identical in tests.
+- A killed server process is restarted by
+  ``runtime.supervisor.ProcessSupervisor``; :func:`serve_pipeline`
+  reloads the journal and re-runs the deterministic shuffle lineage for
+  the in-flight epoch, re-enqueueing only the undelivered remainder.
+- Per-consumer **leases** (heartbeats ride on every request plus an idle
+  keep-alive thread) detect crashed trainers; expiry policy
+  ``RSDL_QUEUE_ON_DEAD_CONSUMER`` = ``fail_fast`` | ``drain`` |
+  ``redistribute`` decides whether the pipeline dies loudly, frees the
+  dead rank's queues, or reroutes its undelivered tables to survivors.
 """
 
 from __future__ import annotations
 
 import collections
 import concurrent.futures as cf
+import json
+import os
 import socket
 import struct
+import sys
 import threading
-from typing import Dict, List, Tuple
+import time
+import zlib
+from typing import Dict, List, Optional, Tuple
 
 import pyarrow as pa
 
 from ray_shuffling_data_loader_tpu import multiqueue as mq
 from ray_shuffling_data_loader_tpu.dataset import ShuffleFailure
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
 from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
 from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
 
-_REQUEST = struct.Struct("<BII")
+_REQUEST = struct.Struct("<BBIII")
 _BATCH_HEADER = struct.Struct("<I")
-_FRAME = struct.Struct("<BQ")
+_FRAME = struct.Struct("<BIIIQQ")
 
 OP_GET_BATCH = 1
+OP_HELLO = 2
+OP_HEARTBEAT = 3
+OP_NACK = 4
+
+FLAG_RESUME = 1
 
 KIND_TABLE = 0
 KIND_SENTINEL = 1
 KIND_FAILURE = 2
 
+#: "no watermark" on the wire (seq is u32; -1 internally).
+ACK_NONE = 0xFFFFFFFF
+
 DEFAULT_MAX_BATCH = 8
+
+
+def _crc(payload) -> int:
+    """CRC-32 (zlib) of a bytes-like payload, as an unsigned u32."""
+    return zlib.crc32(memoryview(payload)) & 0xFFFFFFFF
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -85,36 +157,162 @@ def _serialize(table: pa.Table) -> pa.Buffer:
     return sink.getvalue()
 
 
-def _item_frame(item) -> Tuple[int, bytes]:
-    """Convert one queued item into a ``(kind, payload)`` frame."""
+def _item_frame(item) -> Tuple[int, bytes, int]:
+    """Convert one queued item into a ``(kind, payload, num_rows)`` frame."""
     if item is None:
-        return KIND_SENTINEL, b""
+        return KIND_SENTINEL, b"", 0
     if isinstance(item, ShuffleFailure):
-        return KIND_FAILURE, repr(item.error).encode()
+        return KIND_FAILURE, repr(item.error).encode(), 0
     try:
         table = item.result() if hasattr(item, "result") else item
         from ray_shuffling_data_loader_tpu import spill
         table = spill.unwrap(table)
-        return KIND_TABLE, _serialize(table)
+        return KIND_TABLE, _serialize(table), table.num_rows
     except Exception as e:  # noqa: BLE001 - forwarded
         # A failed shuffle task ref: the consumer gets the real cause as
         # a failure frame, not a dead socket.
-        return KIND_FAILURE, repr(e).encode()
+        return KIND_FAILURE, repr(e).encode(), 0
+
+
+class _Frame:
+    """One serialized response frame held in the server replay buffer."""
+
+    __slots__ = ("seq", "kind", "epoch", "payload", "crc", "row_offset",
+                 "nrows")
+
+    def __init__(self, seq, kind, epoch, payload, crc, row_offset, nrows):
+        self.seq = seq
+        self.kind = kind
+        self.epoch = epoch
+        self.payload = payload
+        self.crc = crc
+        self.row_offset = row_offset
+        self.nrows = nrows
+
+    @property
+    def size(self) -> int:
+        payload = self.payload
+        return payload.size if isinstance(payload, pa.Buffer) \
+            else len(payload)
+
+
+class _QueueState:
+    """Per-queue-index sequencing + replay state (one consumer per queue
+    by the ``queue_id = epoch * num_trainers + rank`` contract)."""
+
+    __slots__ = ("next_seq", "sent_seq", "acked_seq", "acked_rows",
+                 "rows_total", "replay", "replay_bytes", "done", "lock")
+
+    def __init__(self, next_seq: int = 0, rows: int = 0,
+                 done: bool = False):
+        self.next_seq = next_seq       # seq the next popped item gets
+        self.sent_seq = next_seq - 1   # last seq sent on the live conn
+        self.acked_seq = next_seq - 1  # last seq the consumer acked
+        self.acked_rows = rows         # rows delivered through acked_seq
+        self.rows_total = rows         # rows assigned through next_seq-1
+        self.replay: collections.deque = collections.deque()  # unacked
+        self.replay_bytes = 0
+        self.done = done               # sentinel acked: queue complete
+        self.lock = threading.Lock()
+
+
+class _Lease:
+    __slots__ = ("consumer_id", "last_beat", "queues", "expired")
+
+    def __init__(self, consumer_id: int):
+        self.consumer_id = consumer_id
+        self.last_beat = time.monotonic()
+        self.queues: set = set()
+        self.expired = False
+
+
+_POP_CLOSED = object()
+_POP_EMPTY = object()
+
+
+def _put_quiet(queue: mq.MultiQueue, queue_idx: int, item) -> bool:
+    """Best-effort redistribution put: a full or shut-down target queue
+    drops the item (degrading to drain) instead of wedging the lease
+    drainer."""
+    try:
+        queue.put(queue_idx, item)
+        return True
+    except (mq.Full, RuntimeError):
+        return False
 
 
 class QueueServer:
-    """Exports a ``MultiQueue`` over TCP. One thread per consumer
-    connection; the first item of each batched GET blocks server-side
-    until the queue yields (and the ref materializes), so consumer
-    backpressure is preserved; the rest of the batch is an opportunistic
-    non-blocking drain."""
+    """Exports a ``MultiQueue`` over TCP with the v2 sequenced/acked
+    protocol. One thread per consumer connection; the first item of each
+    batched GET blocks server-side until the queue yields (and the ref
+    materializes), so consumer backpressure is preserved; the rest of the
+    batch is an opportunistic non-blocking drain.
 
-    def __init__(self, queue: mq.MultiQueue, address: Tuple[str, int]):
+    ``journal`` (a ``checkpoint.WatermarkJournal``) persists ack
+    watermarks so a restarted server process (``serve_pipeline``) can
+    regenerate exactly the undelivered remainder; ``initial_state`` is
+    that journal's loaded ``{queue_idx: WatermarkEntry}`` map, which
+    restores per-queue sequence numbers and row offsets so frame
+    identity is stable across restarts. ``exit_on_crash_site=True``
+    (the dedicated-server-process mode) turns an injected
+    ``queue_server_crash`` fault into a hard ``os._exit`` — a real
+    process death for the supervisor to recover, not an exception.
+    """
+
+    def __init__(self, queue: mq.MultiQueue, address: Tuple[str, int],
+                 num_trainers: int = 1, journal=None,
+                 initial_state: Optional[Dict[int, object]] = None,
+                 exit_on_crash_site: bool = False):
         self._queue = queue
+        self._num_trainers = max(1, num_trainers)
+        self._journal = journal
+        self._exit_on_crash_site = exit_on_crash_site
+        self._timeout_s = rt_policy.resolve("queue", "queue_timeout_s")
+        self._nodelay = rt_policy.resolve("queue", "queue_nodelay")
+        self._replay_budget = rt_policy.resolve("queue",
+                                                "queue_replay_bytes")
+        self._lease_timeout_s = rt_policy.resolve("queue",
+                                                  "queue_lease_timeout_s")
+        self._on_dead_consumer = rt_policy.resolve("queue",
+                                                   "on_dead_consumer")
+        if self._on_dead_consumer not in ("fail_fast", "drain",
+                                          "redistribute"):
+            raise ValueError(
+                f"RSDL_QUEUE_ON_DEAD_CONSUMER must be fail_fast, drain, or "
+                f"redistribute, got {self._on_dead_consumer!r}")
+        self._states: Dict[int, _QueueState] = {}
+        self._states_lock = threading.Lock()
+        if initial_state:
+            for q, entry in initial_state.items():
+                self._states[q] = _QueueState(next_seq=entry.seq + 1,
+                                              rows=entry.rows,
+                                              done=entry.done)
+        self._leases: Dict[int, _Lease] = {}
+        self._lease_lock = threading.Lock()
+        self._lease_thread: Optional[threading.Thread] = None
+        self._drained_ranks: set = set()
+        self._conn_threads: set = set()
+        self._conn_lock = threading.Lock()
+        self._replayed = rt_metrics.counter(
+            "rsdl_queue_frames_replayed_total",
+            "frames re-sent from the server replay buffer")
+        self._nacked = rt_metrics.counter(
+            "rsdl_queue_frames_nacked_total",
+            "frames NACK'd by consumers (CRC mismatch)")
+        self._lease_expiries = rt_metrics.counter(
+            "rsdl_queue_lease_expiries_total",
+            "consumer leases that expired without a heartbeat")
+        self._consumers_alive = rt_metrics.gauge(
+            "rsdl_queue_consumers_alive",
+            "consumers with a live (unexpired) lease")
         listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         listener.bind(address)
         listener.listen(16)
+        # Finite accept timeout: the accept loop ticks so close() can
+        # stop it deterministically on every platform (and the
+        # socket-op-no-timeout invariant holds by construction).
+        listener.settimeout(1.0)
         self._listener = listener
         self._closed = threading.Event()
         self._accept_thread = threading.Thread(
@@ -125,60 +323,218 @@ class QueueServer:
     def address(self) -> Tuple[str, int]:
         return self._listener.getsockname()
 
+    # -- connection plumbing ------------------------------------------------
+
     def _accept_loop(self) -> None:
         while not self._closed.is_set():
             try:
                 conn, peer = self._listener.accept()
+            except socket.timeout:
+                continue
             except OSError:
                 return
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            threading.Thread(target=self._serve_conn, args=(conn,),
-                             daemon=True, name="rsdl-qserve-conn").start()
+            if self._nodelay:
+                conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Socket hygiene (runtime/policy.py): a finite recv timeout
+            # so a wedged peer cannot pin this handler past the watchdog;
+            # 0 disables (deliberate infinite wait).
+            conn.settimeout(self._timeout_s or None)
+            thread = threading.Thread(target=self._serve_conn, args=(conn,),
+                                      daemon=True, name="rsdl-qserve-conn")
+            with self._conn_lock:
+                self._conn_threads.add(thread)
+            thread.start()
 
-    def _drain_batch(self, queue_idx: int, max_items: int) -> List:
-        """One blocking get, then drain up to ``max_items - 1`` more
-        without blocking; stop after a sentinel/failure so requests never
-        cross an epoch boundary (a speculative get past the sentinel
-        would block forever on the drained per-epoch queue)."""
-        items = [self._queue.get(queue_idx, block=True)]
-        while (len(items) < max_items and items[-1] is not None
-               and not isinstance(items[-1], ShuffleFailure)):
+    def _state(self, queue_idx: int) -> _QueueState:
+        with self._states_lock:
+            state = self._states.get(queue_idx)
+            if state is None:
+                state = self._states[queue_idx] = _QueueState()
+            return state
+
+    def _pop(self, queue_idx: int, blocking: bool, consumer_id):
+        """One queue pop; blocking pops tick on a short timeout so close()
+        (and the consumer's lease) stay live while the queue is idle.
+        ``mq.ShutdownError`` (the QUEUE shut down, not this server)
+        propagates so the consumer gets a loud failure frame."""
+        while not self._closed.is_set():
             try:
-                items.append(self._queue.get_nowait(queue_idx))
+                return self._queue.get(queue_idx, block=blocking,
+                                       timeout=0.25 if blocking else None)
             except mq.Empty:
-                break
-        return items
+                if not blocking:
+                    return _POP_EMPTY
+                # A consumer blocked in a server-side GET is alive by
+                # definition — beat its lease while it waits.
+                self._lease_beat(consumer_id, None)
+        return _POP_CLOSED
+
+    # -- frame building / serving -------------------------------------------
+
+    def _epoch_of(self, queue_idx: int) -> int:
+        return queue_idx // self._num_trainers
+
+    def _apply_ack(self, queue_idx: int, state: _QueueState,
+                   ack: int) -> None:
+        state.acked_seq = ack
+        done = state.done
+        while state.replay and state.replay[0].seq <= ack:
+            frame = state.replay.popleft()
+            state.replay_bytes -= frame.size
+            state.acked_rows = frame.row_offset + frame.nrows
+            if frame.kind == KIND_SENTINEL:
+                done = True
+        state.done = done
+        if self._journal is not None:
+            self._journal.record(queue_idx, ack, state.acked_rows,
+                                 done=done)
+
+    def _collect_frames(self, queue_idx: int, max_items: int,
+                        ack: Optional[int], resume: bool,
+                        consumer_id) -> Optional[List[_Frame]]:
+        """Assemble one response: unacked replay suffix first, then new
+        pops. Returns None when the server closed under the blocking get.
+        """
+        # Fault site: a crash HERE models the whole server process dying
+        # mid-epoch (the supervisor's recovery unit). In dedicated-server
+        # mode it is a real process exit; in-process it downs the server.
+        try:
+            rt_faults.inject("queue_server_crash",
+                             epoch=self._epoch_of(queue_idx),
+                             task=queue_idx)
+        except rt_faults.InjectedFault:
+            if self._exit_on_crash_site:
+                os._exit(137)
+            self.close()
+            raise
+        state = self._state(queue_idx)
+        with state.lock:
+            if ack is not None and ack > state.acked_seq:
+                self._apply_ack(queue_idx, state, ack)
+            if resume:
+                # Reconnect: rewind the send cursor to the watermark so
+                # the unacked suffix replays — the frames a reset ate.
+                state.sent_seq = state.acked_seq
+            frames: List[_Frame] = [f for f in state.replay
+                                    if f.seq > state.sent_seq][:max_items]
+            if frames:
+                self._replayed.inc(len(frames))
+                rt_telemetry.record("frame_replay", epoch=frames[0].epoch,
+                                    task=queue_idx, count=len(frames))
+            while (len(frames) < max_items
+                   and (not frames
+                        or frames[-1].kind == KIND_TABLE)):
+                if frames and state.replay_bytes > self._replay_budget:
+                    # Backpressure: unacked bytes are at budget — stop
+                    # popping (never below one frame per GET, so the
+                    # consumer's acks always make progress possible).
+                    break
+                item = self._pop(queue_idx, blocking=not frames,
+                                 consumer_id=consumer_id)
+                if item is _POP_CLOSED:
+                    return None if not frames else frames
+                if item is _POP_EMPTY:
+                    break
+                kind, payload, nrows = _item_frame(item)
+                seq = state.next_seq
+                state.next_seq += 1
+                row_offset = state.rows_total
+                state.rows_total += nrows
+                if seq <= state.acked_seq:
+                    # Regenerated-after-restart item the consumer already
+                    # consumed (its ack outran the journal's last fsync):
+                    # drop it, but keep the row accounting advancing.
+                    state.acked_rows = row_offset + nrows
+                    continue
+                frame = _Frame(seq, kind, self._epoch_of(queue_idx),
+                               payload, _crc(payload), row_offset, nrows)
+                state.replay.append(frame)
+                state.replay_bytes += frame.size
+                frames.append(frame)
+            if frames:
+                state.sent_seq = frames[-1].seq
+        return frames
+
+    def _send_frames(self, conn: socket.socket, queue_idx: int,
+                     frames: List[_Frame]) -> None:
+        conn.sendall(_BATCH_HEADER.pack(len(frames)))
+        for frame in frames:
+            size = frame.size
+            header = _FRAME.pack(frame.kind, frame.epoch, frame.seq,
+                                 frame.crc, frame.row_offset, size)
+            try:
+                rt_faults.inject("conn_reset_midframe", epoch=frame.epoch,
+                                 task=queue_idx)
+            except rt_faults.InjectedFault as e:
+                # A torn frame then a hard close: the consumer observes
+                # bytes stopping mid-frame — the exact reset-mid-response
+                # shape v2 recovery exists for.
+                conn.sendall(header[:_FRAME.size // 2])
+                raise ConnectionError(
+                    f"injected connection reset mid-frame: {e}") from e
+            corrupt = False
+            try:
+                rt_faults.inject("frame_corrupt", epoch=frame.epoch,
+                                 task=queue_idx)
+            except rt_faults.InjectedFault:
+                corrupt = True
+            conn.sendall(header)
+            if size:
+                if corrupt:
+                    # Flip one payload byte ON THE WIRE only — the replay
+                    # buffer keeps the good copy the NACK re-send needs.
+                    damaged = bytearray(memoryview(frame.payload))
+                    damaged[-1] ^= 0xFF
+                    conn.sendall(damaged)
+                else:
+                    conn.sendall(frame.payload)
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        consumer_id: Optional[int] = None
         try:
             while not self._closed.is_set():
-                raw = conn.recv(_REQUEST.size)
+                try:
+                    raw = conn.recv(_REQUEST.size)
+                except socket.timeout:
+                    continue  # idle tick; leases expire separately
                 if not raw:
                     return  # consumer done
                 if len(raw) < _REQUEST.size:
                     raw += _recv_exact(conn, _REQUEST.size - len(raw))
-                op, queue_idx, max_items = _REQUEST.unpack(raw)
+                op, flags, a, b, c = _REQUEST.unpack(raw)
+                if op == OP_HELLO:
+                    consumer_id = a | (b << 32)
+                    self._lease_beat(consumer_id, None)
+                    continue
+                if op == OP_HEARTBEAT:
+                    self._lease_beat(consumer_id, None)
+                    continue
+                if op == OP_NACK:
+                    self._handle_nack(a, b)
+                    self._lease_beat(consumer_id, a)
+                    continue
                 if op != OP_GET_BATCH:
                     raise ConnectionError(f"unknown request op {op}")
+                queue_idx, max_items = a, b
+                ack = None if c == ACK_NONE else c
+                self._lease_beat(consumer_id, queue_idx)
                 try:
-                    items = self._drain_batch(queue_idx, max(1, max_items))
+                    frames = self._collect_frames(
+                        queue_idx, max(1, max_items), ack,
+                        bool(flags & FLAG_RESUME), consumer_id)
                 except mq.ShutdownError as e:
                     # Queue shut down under a blocked GET: fail loudly
                     # (the reference's actor kill surfaced as
                     # RayActorError on the consumer).
                     text = repr(e).encode()
-                    conn.sendall(_BATCH_HEADER.pack(1)
-                                 + _FRAME.pack(KIND_FAILURE, len(text))
-                                 + text)
+                    conn.sendall(
+                        _BATCH_HEADER.pack(1)
+                        + _FRAME.pack(KIND_FAILURE, 0, ACK_NONE,
+                                      _crc(text), 0, len(text)) + text)
                     return
-                conn.sendall(_BATCH_HEADER.pack(len(items)))
-                for item in items:
-                    kind, payload = _item_frame(item)
-                    size = (payload.size if isinstance(payload, pa.Buffer)
-                            else len(payload))
-                    conn.sendall(_FRAME.pack(kind, size))
-                    if size:
-                        conn.sendall(payload)
+                if frames is None:
+                    return  # server closing: drain quietly
+                self._send_frames(conn, queue_idx, frames)
         except (ConnectionError, OSError) as e:
             if not self._closed.is_set():
                 logger.warning("queue server connection dropped: %s", e)
@@ -187,13 +543,164 @@ class QueueServer:
                 conn.close()
             except OSError:
                 pass
+            with self._conn_lock:
+                self._conn_threads.discard(threading.current_thread())
+
+    def _handle_nack(self, queue_idx: int, bad_seq: int) -> None:
+        state = self._state(queue_idx)
+        with state.lock:
+            state.sent_seq = min(state.sent_seq, bad_seq - 1)
+        self._nacked.inc()
+        rt_telemetry.record("frame_nack", epoch=self._epoch_of(queue_idx),
+                            task=queue_idx, seq=bad_seq)
+        logger.warning("queue %d: consumer NACK'd frame %d (CRC mismatch); "
+                       "re-sending from replay", queue_idx, bad_seq)
+
+    # -- consumer leases ----------------------------------------------------
+
+    def _lease_beat(self, consumer_id: Optional[int],
+                    queue_idx: Optional[int]) -> None:
+        if consumer_id is None:
+            return
+        with self._lease_lock:
+            lease = self._leases.get(consumer_id)
+            if lease is None:
+                lease = self._leases[consumer_id] = _Lease(consumer_id)
+                logger.info("consumer %x: lease granted", consumer_id)
+            lease.last_beat = time.monotonic()
+            lease.expired = False
+            if queue_idx is not None:
+                lease.queues.add(queue_idx)
+            self._consumers_alive.set(
+                sum(1 for le in self._leases.values() if not le.expired))
+            if (self._lease_thread is None
+                    or not self._lease_thread.is_alive()):
+                self._lease_thread = threading.Thread(
+                    target=self._lease_sweeper, daemon=True,
+                    name="rsdl-qserve-lease")
+                self._lease_thread.start()
+
+    def _lease_sweeper(self) -> None:
+        interval = max(0.05, self._lease_timeout_s / 4.0)
+        while not self._closed.wait(interval):
+            now = time.monotonic()
+            newly_dead: List[_Lease] = []
+            with self._lease_lock:
+                for lease in self._leases.values():
+                    if (not lease.expired
+                            and now - lease.last_beat
+                            > self._lease_timeout_s):
+                        lease.expired = True
+                        newly_dead.append(lease)
+                alive = sum(1 for le in self._leases.values()
+                            if not le.expired)
+                self._consumers_alive.set(alive)
+            for lease in newly_dead:
+                self._on_lease_expired(lease)
+
+    def _on_lease_expired(self, lease: _Lease) -> None:
+        self._lease_expiries.inc()
+        rt_telemetry.record("lease_expired", consumer=lease.consumer_id,
+                            queues=sorted(lease.queues),
+                            policy=self._on_dead_consumer)
+        logger.error(
+            "consumer %x: lease expired after %.1fs without a heartbeat "
+            "(queues %s); policy=%s", lease.consumer_id,
+            self._lease_timeout_s, sorted(lease.queues),
+            self._on_dead_consumer)
+        if self._on_dead_consumer == "fail_fast":
+            # The strictest contract: a dead trainer downs the pipeline
+            # loudly rather than silently shuffling for nobody.
+            self.close()
+            return
+        ranks = {q % self._num_trainers for q in lease.queues}
+        with self._lease_lock:
+            ranks -= self._drained_ranks
+            self._drained_ranks |= ranks
+        if not ranks:
+            return
+        redistribute = self._on_dead_consumer == "redistribute"
+        threading.Thread(
+            target=self._drain_dead_ranks, args=(ranks, redistribute),
+            daemon=True, name="rsdl-qserve-lease-drain").start()
+
+    def _survivor_rank(self) -> Optional[int]:
+        with self._lease_lock:
+            ranks = sorted(
+                q % self._num_trainers
+                for lease in self._leases.values() if not lease.expired
+                for q in lease.queues)
+        for rank in ranks:
+            if rank not in self._drained_ranks:
+                return rank
+        return None
+
+    def _drain_dead_ranks(self, ranks: set, redistribute: bool) -> None:
+        """Free (or reroute) a dead consumer's queues so producers are
+        unblocked and its tables don't leak until process exit."""
+        num_queues = self._queue.num_queues
+        dead_queues = [q for q in range(num_queues)
+                       if q % self._num_trainers in ranks]
+        for q in dead_queues:
+            state = self._state(q)
+            with state.lock:
+                state.replay.clear()
+                state.replay_bytes = 0
+        while not self._closed.wait(0.2):
+            moved = 0
+            for q in dead_queues:
+                while True:
+                    try:
+                        item = self._queue.get_nowait(q)
+                    except (mq.Empty, mq.ShutdownError, RuntimeError):
+                        break
+                    moved += 1
+                    if not redistribute or item is None or isinstance(
+                            item, ShuffleFailure):
+                        continue  # drained and dropped
+                    survivor = self._survivor_rank()
+                    if survivor is None:
+                        continue  # nobody left: degrade to drain
+                    target = (self._epoch_of(q) * self._num_trainers
+                              + survivor)
+                    if _put_quiet(self._queue, target, item):
+                        rt_telemetry.record(
+                            "frame_redistributed", epoch=self._epoch_of(q),
+                            task=target, source_queue=q)
+            if moved:
+                logger.info("dead-consumer policy %s: moved %d items off "
+                            "ranks %s",
+                            "redistribute" if redistribute else "drain",
+                            moved, sorted(ranks))
+
+    # -- lifecycle ----------------------------------------------------------
 
     def close(self) -> None:
+        """Stop accepting, drain in-flight responses, join every handler.
+
+        Handler threads finish the frame they are writing, observe the
+        closed flag at the next loop tick (blocking pops tick at 250 ms),
+        and exit without logging — so no thread can raise into the logger
+        after the listener is gone (the PR-5 shutdown-race fix).
+        """
+        if self._closed.is_set():
+            return
         self._closed.set()
         try:
             self._listener.close()
         except OSError:
             pass
+        with self._conn_lock:
+            threads = list(self._conn_threads)
+        for thread in threads:
+            if thread is threading.current_thread():
+                continue  # a handler downing its own server cannot join itself
+            thread.join(timeout=5.0)
+            if thread.is_alive():
+                logger.warning(
+                    "queue server handler %s did not drain within 5s",
+                    thread.name)
+        self._accept_thread.join(timeout=2.0)
 
     def __enter__(self) -> "QueueServer":
         return self
@@ -203,9 +710,15 @@ class QueueServer:
 
 
 def serve_queue(queue: mq.MultiQueue,
-                address: Tuple[str, int] = ("127.0.0.1", 0)) -> QueueServer:
+                address: Tuple[str, int] = ("127.0.0.1", 0),
+                num_trainers: int = 1,
+                journal=None,
+                initial_state: Optional[Dict[int, object]] = None,
+                exit_on_crash_site: bool = False) -> QueueServer:
     """Start serving ``queue`` on ``address`` (port 0 = ephemeral)."""
-    return QueueServer(queue, address)
+    return QueueServer(queue, address, num_trainers=num_trainers,
+                       journal=journal, initial_state=initial_state,
+                       exit_on_crash_site=exit_on_crash_site)
 
 
 class RemoteQueue:
@@ -222,14 +735,44 @@ class RemoteQueue:
     (default) a background thread keeps the next batched request in
     flight while the consumer drains the local buffer — the wire is
     overlapped with consumption instead of serialized against it.
+
+    v2 recovery surface:
+
+    - every frame's CRC is verified; a corrupt frame is NACK'd and
+      re-fetched from the server's replay buffer — the stream never
+      carries damaged bytes forward.
+    - a connection failure at ANY point (including mid-response) is
+      recovered by reconnect + resume: the first GET per queue after a
+      (re)connect carries ``FLAG_RESUME`` and the delivered watermark,
+      the server replays the unacked suffix, and frames at-or-below the
+      watermark are dropped client-side — exactly-once delivery.
+    - ``ack_mode="delivered"`` (default) acks each frame as ``get``
+      returns it. ``ack_mode="manual"`` holds acks until
+      :meth:`commit` — the checkpoint integration: ``resume_iterator``
+      commits at every checkpoint save, so a killed-and-resumed trainer
+      finds everything since its last checkpoint still replayable.
+    - a heartbeat thread keeps the server-side consumer lease alive
+      between GETs (long train steps must not read as a dead trainer).
     """
 
     def __init__(self, address: Tuple[str, int],
                  retries: int = mq.CONNECT_RETRIES,
                  initial_backoff_s: float = mq.CONNECT_INITIAL_BACKOFF_S,
                  max_batch: int = DEFAULT_MAX_BATCH,
-                 prefetch: bool = True):
+                 prefetch: bool = True,
+                 ack_mode: str = "delivered",
+                 consumer_id: Optional[int] = None):
+        if ack_mode not in ("delivered", "manual"):
+            raise ValueError(
+                f"ack_mode must be 'delivered' or 'manual', got {ack_mode!r}")
         self._address = address
+        self._ack_mode = ack_mode
+        self._consumer_id = (consumer_id if consumer_id is not None
+                             else int.from_bytes(os.urandom(8), "little"))
+        self._timeout_s = rt_policy.resolve("queue", "queue_timeout_s")
+        self._nodelay = rt_policy.resolve("queue", "queue_nodelay")
+        self._lease_timeout_s = rt_policy.resolve("queue",
+                                                  "queue_lease_timeout_s")
         # One RetryPolicy for connect AND mid-stream refetch: jittered
         # doubling backoff (many trainer processes dialing one server
         # de-synchronize), attempts pinned by the caller's budget.
@@ -237,6 +780,28 @@ class RemoteQueue:
             "queue", retry_max_attempts=retries + 1,
             retry_initial_backoff_s=initial_backoff_s,
             retryable=rt_retry.transient_retryable)
+        self._io_lock = threading.Lock()      # serializes wire round trips
+        self._state_lock = threading.Lock()   # guards buffers/done/pending
+        self._closed = threading.Event()
+        #: queue -> deque of (seq, row_offset_or_None, item)
+        self._buffers: Dict[int, collections.deque] = \
+            collections.defaultdict(collections.deque)
+        self._done: set = set()
+        self._pending: Dict[int, cf.Future] = {}
+        #: last seq handed to the application, per queue (-1 = none).
+        self._delivered: Dict[int, int] = collections.defaultdict(lambda: -1)
+        #: ack watermark for manual mode (advanced by commit()).
+        self._committed: Dict[int, int] = collections.defaultdict(lambda: -1)
+        #: queues that completed a fetch on the CURRENT connection; the
+        #: first GET per queue per connection carries FLAG_RESUME (a
+        #: no-op on a healthy stream, a replay after any reconnect).
+        self._fetched_since_connect: set = set()
+        self._reconnects = rt_metrics.counter(
+            "rsdl_queue_client_reconnects_total",
+            "RemoteQueue reconnect-and-resume cycles")
+        self._corrupt = rt_metrics.counter(
+            "rsdl_queue_frames_corrupt_total",
+            "frames rejected client-side on CRC mismatch")
         try:
             self._retry.call(self._reconnect, describe=f"connect {address}")
         except OSError as e:
@@ -245,97 +810,209 @@ class RemoteQueue:
                 f"{retries + 1} attempts: {e}")
         self._max_batch = max(1, max_batch)
         self._prefetch = prefetch
-        self._io_lock = threading.Lock()      # serializes wire round trips
-        self._state_lock = threading.Lock()   # guards buffers/done/pending
-        self._buffers: Dict[int, collections.deque] = \
-            collections.defaultdict(collections.deque)
-        self._done: set = set()
-        self._pending: Dict[int, cf.Future] = {}
         self._io = cf.ThreadPoolExecutor(
             max_workers=1, thread_name_prefix="rsdl-rqueue-prefetch")
+        self._heartbeat_thread = threading.Thread(
+            target=self._heartbeat_loop, daemon=True,
+            name="rsdl-rqueue-heartbeat")
+        self._heartbeat_thread.start()
 
     def _reconnect(self) -> None:
         """(Re-)dial the queue server; the old socket (if any) is closed
-        first so a half-dead connection cannot leak."""
-        old = getattr(self, "_sock", None)
-        if old is not None:
+        first so a half-dead connection cannot leak. Sends the lease
+        HELLO and arms per-queue resume so the next GET on every queue
+        replays the unacked suffix."""
+        with self._io_lock:
+            old = getattr(self, "_sock", None)
+            if old is not None:
+                try:
+                    old.close()
+                except OSError:
+                    pass
+                self._reconnects.inc()
+            sock = socket.create_connection(self._address, timeout=30)
+            # Socket hygiene via runtime/policy.py: finite recv timeout
+            # (0 disables). With v2 resume, a timed-out response is
+            # simply reconnected-and-replayed — never lost data.
+            sock.settimeout(self._timeout_s or None)
+            if self._nodelay:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            sock.sendall(_REQUEST.pack(
+                OP_HELLO, 0, self._consumer_id & 0xFFFFFFFF,
+                (self._consumer_id >> 32) & 0xFFFFFFFF, 0))
+            self._sock = sock
+            self._fetched_since_connect = set()
+
+    def _heartbeat_loop(self) -> None:
+        """Keep the server-side lease alive while the trainer chews on a
+        long step between GETs. Skips a beat rather than queueing behind
+        an in-flight round trip (which beats the lease by itself)."""
+        interval = max(0.2, self._lease_timeout_s / 3.0)
+        while not self._closed.wait(interval):
+            if not self._io_lock.acquire(timeout=interval / 2):
+                continue  # a round trip is in flight: that IS a beat
             try:
-                old.close()
+                self._sock.sendall(_REQUEST.pack(OP_HEARTBEAT, 0, 0, 0, 0))
             except OSError:
-                pass
-        sock = socket.create_connection(self._address, timeout=30)
-        sock.settimeout(None)
-        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        self._sock = sock
+                pass  # next fetch reconnects; lease survives the gap
+            finally:
+                self._io_lock.release()
 
-    def _fetch_batch(self, queue_index: int) -> List:
+    def _ack_for(self, queue_index: int) -> int:
+        watermark = (self._committed[queue_index]
+                     if self._ack_mode == "manual"
+                     else self._delivered[queue_index])
+        return ACK_NONE if watermark < 0 else watermark
+
+    def commit(self, queue_index: Optional[int] = None) -> None:
+        """Advance the manual-ack watermark to everything delivered so
+        far (one queue, or all). Call after durably recording consumption
+        — e.g. a checkpoint save; ``resume_iterator`` does this through
+        ``ShufflingDataset.commit_consumed``."""
+        with self._state_lock:
+            indices = ([queue_index] if queue_index is not None
+                       else list(self._delivered))
+            for q in indices:
+                self._committed[q] = max(self._committed[q],
+                                         self._delivered[q])
+
+    def _fetch_batch(self, queue_index: int) -> Tuple[List, bool]:
         """One wire round trip: request up to ``max_batch`` items and
-        decode the response frames. Runs on the caller's thread or the
-        prefetcher; ``_io_lock`` keeps round trips whole.
+        decode + CRC-verify the response frames. Runs on the caller's
+        thread or the prefetcher; ``_io_lock`` keeps round trips whole.
 
-        Failure handling rides the shared RetryPolicy: a round trip that
-        dies BEFORE any response byte arrived (server restart, injected
-        ``queue_fetch`` fault) reconnects and re-issues the request — the
-        server pops queue items only while writing the response, so an
-        unanswered request consumed nothing and the re-request cannot
-        skip data. Once response bytes have been read, a failure is NOT
-        retried (items may already be popped server-side; a blind
-        re-request could silently lose them) and surfaces loudly.
+        Failure handling rides the shared RetryPolicy: ANY round-trip
+        death — before or after response bytes — reconnects and resumes.
+        The v2 sequence numbers make the resume exact: the server replays
+        from the ack watermark and frames the client already delivered
+        are dropped by seq, so a reset can neither lose nor duplicate an
+        item (the v1 protocol had to fail loudly mid-response here).
         """
 
-        def _round_trip() -> List:
+        def _round_trip() -> Tuple[List[Tuple], bool]:
             response_started = False
+            epoch_hint = None
             try:
                 with self._io_lock:
                     rt_faults.inject("queue_fetch", task=queue_index)
+                    resume = queue_index not in self._fetched_since_connect
+                    ack = self._ack_for(queue_index)
+                    try:
+                        rt_faults.inject("ack_lost", task=queue_index)
+                    except rt_faults.InjectedFault:
+                        # A lost ack is harmless by design: acks are
+                        # cumulative, the next GET's watermark covers it.
+                        rt_telemetry.record("ack_lost", task=queue_index,
+                                            suppressed_ack=ack)
+                        ack = ACK_NONE
                     self._sock.sendall(_REQUEST.pack(
-                        OP_GET_BATCH, queue_index, self._max_batch))
+                        OP_GET_BATCH, FLAG_RESUME if resume else 0,
+                        queue_index, self._max_batch, ack))
                     (count,) = _BATCH_HEADER.unpack(
                         _recv_exact(self._sock, _BATCH_HEADER.size))
                     response_started = True
                     frames = []
+                    corrupt_seq = None
                     for _ in range(count):
-                        kind, length = _FRAME.unpack(
-                            _recv_exact(self._sock, _FRAME.size))
+                        kind, epoch, seq, crc, row_offset, length = \
+                            _FRAME.unpack(_recv_exact(self._sock,
+                                                      _FRAME.size))
+                        epoch_hint = epoch
                         payload = (_recv_exact(self._sock, length)
                                    if length else b"")
-                        frames.append((kind, payload))
-                return frames
+                        if corrupt_seq is not None:
+                            continue  # drain framing past the bad frame
+                        if _crc(payload) != crc:
+                            # End-to-end integrity: reject the frame and
+                            # everything after it (in-order delivery),
+                            # but keep READING so the stream framing
+                            # stays aligned; NACK below so the server
+                            # rewinds and re-sends the good copy from
+                            # its replay buffer.
+                            corrupt_seq = seq
+                            self._corrupt.inc()
+                            rt_telemetry.record("frame_corrupt",
+                                                epoch=epoch,
+                                                task=queue_index, seq=seq)
+                            logger.warning(
+                                "queue %d: frame %d failed CRC; NACKing",
+                                queue_index, seq)
+                            continue
+                        frames.append((kind, seq, row_offset, payload))
+                    if corrupt_seq is not None:
+                        self._sock.sendall(_REQUEST.pack(
+                            OP_NACK, 0, queue_index, corrupt_seq, 0))
+                    self._fetched_since_connect.add(queue_index)
+                return frames, resume
             except (ConnectionError, OSError) as e:
                 if response_started:
-                    raise RuntimeError(
-                        f"queue fetch for index {queue_index} died "
-                        f"mid-response; items may be lost — not retrying: "
-                        f"{e}") from e
+                    # Mid-response reset: v1's unrecoverable case, now
+                    # the recovery path's bread and butter. The plain
+                    # event joins an injected conn_reset_midframe fault
+                    # by (kind, epoch, task) — by construction.
+                    rt_telemetry.record("conn_reset_midframe",
+                                        epoch=epoch_hint, task=queue_index,
+                                        error=str(e))
+                    logger.warning(
+                        "queue %d: connection died mid-response (%s); "
+                        "reconnecting and replaying the unacked suffix",
+                        queue_index, e)
                 raise
 
         def _redial(error: BaseException) -> None:
-            if isinstance(error, (ConnectionError, OSError)):
+            if not isinstance(error, (ConnectionError, OSError)):
+                return
+            try:
                 self._reconnect()
+            except OSError as e:
+                # A restarting server may not be accepting yet; the old
+                # socket is already closed, so the NEXT attempt fails
+                # fast and this redial runs again after its backoff —
+                # the reconnect storm spends the retry budget, it does
+                # not escape it.
+                logger.info("queue redial to %s not up yet (%s); will "
+                            "retry", self._address, e)
 
         with rt_telemetry.span("queue_fetch", task=queue_index):
-            frames = self._retry.call(
+            frames, resumed = self._retry.call(
                 _round_trip, describe=f"fetch queue {queue_index}",
                 on_retry=_redial)
-        items: List = []
-        for kind, payload in frames:
+        items: List[Tuple] = []
+        for kind, seq, row_offset, payload in frames:
             if kind == KIND_SENTINEL:
-                items.append(None)
+                items.append((seq, None, None))
                 break  # epoch over; nothing valid can follow
             if kind == KIND_FAILURE:
-                items.append(ShuffleFailure(RuntimeError(payload.decode())))
+                items.append((seq, None,
+                              ShuffleFailure(RuntimeError(payload.decode()))))
                 break
             with pa.ipc.open_stream(pa.BufferReader(payload)) as reader:
-                items.append(reader.read_all())
-        return items
+                items.append((seq, row_offset, reader.read_all()))
+        return items, resumed
 
-    def _epoch_over(self, item) -> bool:
+    def _epoch_over(self, entry) -> bool:
+        _, _, item = entry
         return item is None or isinstance(item, ShuffleFailure)
 
-    def _ingest(self, queue_index: int, items: List) -> None:
+    def _ingest(self, queue_index: int, items: List[Tuple],
+                resumed: bool) -> None:
         buf = self._buffers[queue_index]
-        buf.extend(items)
-        if items and self._epoch_over(items[-1]):
+        if resumed:
+            # The server replayed from the ack watermark: locally
+            # buffered-but-undelivered copies are superseded by the
+            # replay (same seqs), so drop them rather than double-buffer.
+            buf.clear()
+        delivered = self._delivered[queue_index]
+        fresh = []
+        for seq, row_offset, item in items:
+            if seq <= delivered or (buf and seq <= buf[-1][0]):
+                continue  # replayed frame we already have: exactly-once
+            if item is None and row_offset is None:
+                fresh.append((seq, None, None))
+            else:
+                fresh.append((seq, row_offset, item))
+        buf.extend(fresh)
+        if fresh and self._epoch_over(fresh[-1]):
             self._done.add(queue_index)
         elif self._prefetch and queue_index not in self._pending:
             # Submit the NEXT batched request as soon as this one lands,
@@ -349,9 +1026,12 @@ class RemoteQueue:
             self._pending[queue_index] = self._io.submit(
                 self._fetch_batch, queue_index)
 
-    def get(self, queue_index: int, block: bool = True):
-        if not block:
-            raise ValueError("RemoteQueue only supports blocking gets")
+    def get_positioned(self, queue_index: int):
+        """Blocking get returning ``(item, row_offset)``: the item plus
+        the absolute row position of its first row in this queue's stream
+        (None for sentinels/failures). ``ShufflingDataset`` uses the
+        position to make checkpoint-resume skips exact against a
+        replaying stream."""
         with self._state_lock:
             buf = self._buffers[queue_index]
             while not buf:
@@ -379,18 +1059,28 @@ class RemoteQueue:
                     # release/reacquire bracket above/below); the static
                     # with-block scope is wider than the dynamic hold:
                     # rsdl-lint: disable=lock-blocking-call
-                    items = fut.result()
+                    items, resumed = fut.result()
                 finally:
                     self._state_lock.acquire()
                     mine = self._pending.get(queue_index) is fut
                     if mine:
                         del self._pending[queue_index]
                 if mine:
-                    self._ingest(queue_index, items)
-            item = buf.popleft()
+                    self._ingest(queue_index, items, resumed)
+            seq, row_offset, item = buf.popleft()
+            if seq != ACK_NONE:  # out-of-band failure frames carry no seq
+                self._delivered[queue_index] = max(
+                    self._delivered[queue_index], seq)
+        return item, row_offset
+
+    def get(self, queue_index: int, block: bool = True):
+        if not block:
+            raise ValueError("RemoteQueue only supports blocking gets")
+        item, _ = self.get_positioned(queue_index)
         return item
 
     def close(self) -> None:
+        self._closed.set()
         self._io.shutdown(wait=False, cancel_futures=True)
         try:
             self._sock.close()
@@ -402,3 +1092,135 @@ class RemoteQueue:
 
     def __exit__(self, *exc) -> None:
         self.close()
+
+
+# ---------------------------------------------------------------------------
+# Dedicated-server-process mode: build the whole producer pipeline (queue +
+# deterministic shuffle + v2 server) from a config dict, resuming from the
+# delivered-watermark journal — the unit runtime.supervisor restarts.
+# ---------------------------------------------------------------------------
+
+
+def _resume_plan(state: Dict[int, object], num_epochs: int,
+                 num_trainers: int) -> Tuple[int, Dict[int, int]]:
+    """``(start_epoch, skip_items)`` from a loaded journal: the first
+    epoch any rank has not fully consumed, and per-queue counts of items
+    (tables + sentinel) already delivered that the re-run must not
+    re-enqueue."""
+    start_epoch = num_epochs
+    for rank in range(num_trainers):
+        for epoch in range(num_epochs):
+            entry = state.get(epoch * num_trainers + rank)
+            if entry is None or not entry.done:
+                start_epoch = min(start_epoch, epoch)
+                break
+    skip_items = {q: entry.seq + 1 for q, entry in state.items()
+                  if q // num_trainers >= start_epoch}
+    return start_epoch, skip_items
+
+
+def _resuming_batch_consumer(queue: mq.MultiQueue, num_trainers: int,
+                             skip_items: Dict[int, int]):
+    """``batch_consumer`` that re-runs the lineage but enqueues only the
+    undelivered remainder: the first ``skip_items[q]`` items of each
+    queue's deterministic stream (tables, then the sentinel) are dropped
+    — they are already journaled as delivered."""
+    remaining = dict(skip_items)
+    lock = threading.Lock()
+
+    def consumer(rank, epoch, refs):
+        queue_idx = epoch * num_trainers + rank
+        with lock:
+            to_skip = remaining.get(queue_idx, 0)
+            if refs is None:
+                if to_skip > 0:
+                    remaining[queue_idx] = to_skip - 1
+                    return
+            else:
+                refs = list(refs)
+                dropped = min(to_skip, len(refs))
+                remaining[queue_idx] = to_skip - dropped
+                refs = refs[dropped:]
+                if not refs:
+                    return
+        if refs is None:
+            queue.put(queue_idx, None)
+        else:
+            queue.put_batch(queue_idx, refs)
+
+    return consumer
+
+
+def serve_pipeline(config: dict):
+    """Child-process entry: queue + shuffle + v2 server from ``config``.
+
+    Resumes from the journal at ``config["journal_path"]``: per-queue
+    sequence numbers and row offsets restore to their journaled
+    watermarks, the shuffle re-runs from the first incomplete epoch
+    (``(seed, epoch, task)`` determinism makes the re-run bit-identical),
+    and already-delivered items are dropped before the queue — so the
+    restarted server serves exactly the undelivered remainder.
+
+    Returns ``(server, shuffle_result, queue)``.
+    """
+    from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+    from ray_shuffling_data_loader_tpu import dataset as ds
+    import importlib
+    sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
+
+    num_epochs = int(config["num_epochs"])
+    num_trainers = int(config["num_trainers"])
+    journal_path = config["journal_path"]
+    state = ckpt.WatermarkJournal.load(journal_path)
+    start_epoch, skip_items = _resume_plan(state, num_epochs, num_trainers)
+    if state:
+        logger.warning(
+            "queue server resuming from journal %s: start_epoch=%d, "
+            "skipping %s already-delivered items",
+            journal_path, start_epoch,
+            {q: n for q, n in skip_items.items() if n})
+    journal = ckpt.WatermarkJournal(journal_path)
+    journal.compact()
+    queue = mq.MultiQueue(num_epochs * num_trainers)
+    consumer = _resuming_batch_consumer(queue, num_trainers, skip_items)
+    shuffle_result = sh.run_shuffle_in_background(
+        list(config["filenames"]), consumer, num_epochs,
+        int(config["num_reducers"]), num_trainers,
+        int(config.get("max_concurrent_epochs", 2)),
+        seed=int(config.get("seed", 0)),
+        num_workers=config.get("num_workers"),
+        collect_stats=False, start_epoch=start_epoch,
+        file_cache=config.get("file_cache", "auto"),
+        on_failure=ds.make_failure_broadcaster(
+            queue, num_epochs * num_trainers))
+    server = QueueServer(
+        queue, (config.get("host", "127.0.0.1"), int(config["port"])),
+        num_trainers=num_trainers, journal=journal, initial_state=state,
+        exit_on_crash_site=True)
+    return server, shuffle_result, queue
+
+
+def _serve_main(argv: List[str]) -> int:
+    """``python -m ray_shuffling_data_loader_tpu.multiqueue_service
+    <config.json>`` — the supervised queue-server child process."""
+    if len(argv) != 2:
+        print("usage: python -m ray_shuffling_data_loader_tpu."
+              "multiqueue_service <config.json>", file=sys.stderr)
+        return 2
+    with open(argv[1]) as f:
+        config = json.load(f)
+    server, shuffle_result, queue = serve_pipeline(config)
+    print(f"READY {server.address[1]}", flush=True)
+    try:
+        shuffle_result.result()
+        # Shuffling is done but consumers may still be draining (and
+        # re-fetching replays); serve until the supervisor stops us.
+        threading.Event().wait()
+    finally:
+        server.close()
+        queue.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(_serve_main(sys.argv))

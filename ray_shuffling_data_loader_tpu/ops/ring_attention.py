@@ -38,6 +38,21 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 NEG_INF = -1e9  # finite, like models/bert.py — keeps softmax NaN-free
+
+
+def _shard_map(fn, *, mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the API move: the public ``jax.shard_map``
+    (with ``check_vma``) landed after 0.4.x; earlier jax ships it as
+    ``jax.experimental.shard_map.shard_map`` (with ``check_rep``). Both
+    replication checks are disabled — the attention bodies run collectives
+    whose replication the checker cannot infer."""
+    public = getattr(jax, "shard_map", None)
+    if public is not None:
+        return public(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+    from jax.experimental.shard_map import shard_map as experimental
+    return experimental(fn, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=False)
 _ACC_MIN = -1e30
 
 
@@ -356,13 +371,13 @@ def _dispatch_sharded(shard_fn, q, k, v, bias, mesh: Mesh, seq_axis: str,
     qkv_spec = P(batch_axis, None, seq_axis, None)
     bias_spec = P(batch_axis, None, None, seq_axis)
     if bias is None:
-        fn = jax.shard_map(lambda q_, k_, v_: shard_fn(q_, k_, v_, None),
-                           mesh=mesh, in_specs=(qkv_spec,) * 3,
-                           out_specs=qkv_spec, check_vma=False)
+        fn = _shard_map(lambda q_, k_, v_: shard_fn(q_, k_, v_, None),
+                        mesh=mesh, in_specs=(qkv_spec,) * 3,
+                        out_specs=qkv_spec)
         return fn(q, k, v)
-    fn = jax.shard_map(shard_fn, mesh=mesh,
-                       in_specs=(qkv_spec,) * 3 + (bias_spec,),
-                       out_specs=qkv_spec, check_vma=False)
+    fn = _shard_map(shard_fn, mesh=mesh,
+                    in_specs=(qkv_spec,) * 3 + (bias_spec,),
+                    out_specs=qkv_spec)
     return fn(q, k, v, bias)
 
 

@@ -237,6 +237,12 @@ class TcpTransport:
             except OSError:
                 return  # listener closed
             conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            # Explicit infinite recv: idle links between epochs are
+            # normal, and the recv loop's exit path is transport.close()
+            # closing this conn (the recv() API's own timeout is enforced
+            # tag-side). A silent default would be a bug; this is the
+            # reviewed decision the socket-op-no-timeout rule asks for.
+            conn.settimeout(None)
             thread = threading.Thread(target=self._recv_loop, args=(conn,),
                                       daemon=True,
                                       name=f"rsdl-transport-recv-{self.host_id}")

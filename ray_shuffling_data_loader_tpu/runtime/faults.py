@@ -18,6 +18,19 @@ site                 where it fires
 ``spill_write``      ``spill.SpillManager.maybe_spill``
 ``spill_read``       ``spill.SpilledTable.load``
 ``device_transfer``  the ``jax.device_put`` in ``jax_dataset``
+``queue_server_crash``  ``QueueServer`` GET handling — the whole server
+                     process dies (``os._exit`` in dedicated-server
+                     mode; in-process servers close) and the supervisor
+                     must restart it from the watermark journal
+``conn_reset_midframe``  ``QueueServer`` response writing — a torn frame
+                     then a hard close, the reset-mid-response shape the
+                     v2 replay protocol recovers
+``frame_corrupt``    ``QueueServer`` response writing — one payload byte
+                     flipped ON THE WIRE (replay buffer keeps the good
+                     copy); the consumer CRC-rejects and NACKs
+``ack_lost``         ``RemoteQueue`` request sending — one GET's ack
+                     watermark suppressed; harmless by design (acks are
+                     cumulative)
 ===================  ======================================================
 
 A chaos spec (``RSDL_CHAOS_SPEC`` env var, or :func:`install`) is a
@@ -70,6 +83,9 @@ SITES = frozenset({
     "map_read", "reduce_gather", "queue_put", "queue_get", "queue_fetch",
     "transport_send", "transport_recv", "spill_write", "spill_read",
     "device_transfer",
+    # Process-level sites (PR 5): the cross-process queue topology.
+    "queue_server_crash", "conn_reset_midframe", "frame_corrupt",
+    "ack_lost",
 })
 
 _SPEC_ENVS = ("RSDL_CHAOS_SPEC", "RSDL_FAULTS_SPEC")

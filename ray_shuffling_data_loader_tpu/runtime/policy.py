@@ -92,6 +92,26 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     "metrics_file": ("", str),
     "metrics_port": (0, int),
     "metrics_interval_s": (5.0, float),
+    # Cross-process queue service (multiqueue_service.py) socket hygiene:
+    # recv timeout applied to BOTH serve_queue connections and
+    # RemoteQueue dials (0 = no timeout — a deliberate infinite wait;
+    # with protocol v2 a timed-out response is reconnected-and-replayed,
+    # never lost), and TCP_NODELAY on both ends.
+    "queue_timeout_s": (300.0, float),
+    "queue_nodelay": (True, _parse_bool),
+    # Per-queue replay-buffer byte budget: unacked frames held for
+    # reconnect replay. At the budget the server stops popping new items
+    # (backpressure) rather than dropping unacked data.
+    "queue_replay_bytes": (256 << 20, int),
+    # Consumer lease: seconds without a heartbeat/request before a
+    # consumer is declared dead. Client heartbeats run at a third of it.
+    "queue_lease_timeout_s": (30.0, float),
+    # What the server does when a consumer's lease expires
+    # (RSDL_QUEUE_ON_DEAD_CONSUMER): "fail_fast" (down the server so the
+    # pipeline fails loudly), "drain" (free the dead rank's queues so
+    # producers are unblocked and memory is released), "redistribute"
+    # (reroute its undelivered tables to a surviving consumer).
+    "on_dead_consumer": ("fail_fast", str),
     # What shuffle_map does with a corrupt/unreadable input file after
     # read retries are exhausted: "raise" (fail the map task; lineage
     # recovery then retries it, and only exhausted recovery poisons the

@@ -30,6 +30,7 @@ TPU-native design differences:
 
 from __future__ import annotations
 
+import functools
 import threading
 import timeit
 from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple,
@@ -745,6 +746,32 @@ def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
     return shuffled
 
 
+def recompute_reducer_output(filenames: Sequence[str], num_reducers: int,
+                             seed: int, epoch: int, reduce_index: int,
+                             map_transform: Optional[MapTransform] = None,
+                             reduce_transform: Optional[ReduceTransform]
+                             = None,
+                             on_bad_file: str = "raise") -> pa.Table:
+    """Rebuild one reducer output from scratch lineage: re-read every
+    input file, re-plan its scatter, and re-run the fused reduce — a pure
+    function of ``(seed, epoch, reduce_index)`` and the files, so the
+    result is bit-identical to the original. This is the spill tier's
+    corruption-recovery path (spill.py): deliberately self-contained (no
+    map-shard refs captured) so an armed :class:`spill.SpilledTable`
+    handle pins only this closure's small arguments, never an epoch's
+    decoded tables."""
+    chunks = []
+    for file_index, filename in enumerate(filenames):
+        shard = shuffle_map(filename, num_reducers, seed, epoch,
+                            file_index, None, map_transform, None,
+                            on_bad_file, None)
+        if isinstance(shard, rt_faults.QuarantinedFile):
+            continue
+        chunks.append(shard[reduce_index])
+    return shuffle_reduce(reduce_index, seed, epoch, chunks, None,
+                          reduce_transform)
+
+
 class EpochLineage:
     """Recompute lost map outputs from their ``(seed, epoch, file)`` lineage.
 
@@ -856,8 +883,8 @@ def _reduce_task(reduce_index: int, seed: int, epoch: int,
                  spill_manager=None,
                  gather_threads: Optional[int] = None,
                  lineage: Optional[EpochLineage] = None,
-                 retry_policy: Optional[rt_retry.RetryPolicy] = None
-                 ) -> pa.Table:
+                 retry_policy: Optional[rt_retry.RetryPolicy] = None,
+                 spill_recompute=None) -> pa.Table:
     """Executor wrapper: resolve this reducer's chunk from every map output.
 
     Equivalent of Ray resolving ``shuffle_reduce.remote(*refs)`` argument
@@ -908,21 +935,30 @@ def _reduce_task(reduce_index: int, seed: int, epoch: int,
             _gather_and_shuffle,
             describe=f"reduce e{epoch} r{reduce_index}",
             on_recovery=_recovered)
-    return account_and_maybe_spill(shuffled, spill_manager)
+    return account_and_maybe_spill(shuffled, spill_manager,
+                                   recompute=spill_recompute,
+                                   epoch=epoch, task=reduce_index)
 
 
-def account_and_maybe_spill(shuffled: pa.Table, spill_manager) -> pa.Table:
+def account_and_maybe_spill(shuffled: pa.Table, spill_manager,
+                            recompute=None, epoch: Optional[int] = None,
+                            task: Optional[int] = None) -> pa.Table:
     """Post-reduce memory policy, shared by the single-host and distributed
     reduce wrappers so their semantics cannot diverge: charge the output's
     in-flight bytes to the buffer ledger (plasma's store-utilization role;
     the max_inflight_bytes throttle reads the same counter), then spill it
     if a spill manager is active and the pipeline is over budget — the
     SpilledTable handle replaces the table, so the in-memory copy is
-    released as soon as the reduce task returns."""
+    released as soon as the reduce task returns. ``recompute`` (single-
+    host path: :func:`recompute_reducer_output` bound to this reducer's
+    lineage) arms the handle's corrupt-spill recovery; the cross-host
+    path passes None — its inputs crossed the wire, so a corrupt spill
+    there stays a loud failure."""
     from ray_shuffling_data_loader_tpu import native
     native.account_table(shuffled)
     if spill_manager is not None:
-        shuffled = spill_manager.maybe_spill(shuffled)
+        shuffled = spill_manager.maybe_spill(shuffled, recompute=recompute,
+                                             epoch=epoch, task=task)
     return shuffled
 
 
@@ -986,10 +1022,21 @@ def shuffle_epoch(epoch: int,
                            retry_policy=policies.get("lineage"),
                            on_bad_file=on_bad_file,
                            read_retry=policies.get("read"))
+    filenames_list = list(filenames)
+
+    def _spill_recompute_for(reduce_index: int):
+        if spill_manager is None:
+            return None
+        return functools.partial(
+            recompute_reducer_output, filenames_list, num_reducers, seed,
+            epoch, reduce_index, map_transform, reduce_transform,
+            on_bad_file)
+
     reduce_refs = [
         pool.submit(_reduce_task, reduce_index, seed, epoch, map_refs,
                     stats_collector, reduce_transform, spill_manager,
-                    gather_threads, lineage, policies.get("reduce"))
+                    gather_threads, lineage, policies.get("reduce"),
+                    _spill_recompute_for(reduce_index))
         for reduce_index in range(num_reducers)
     ]
     for trainer_idx, batches in enumerate(

@@ -21,7 +21,9 @@ import shutil
 import tempfile
 import threading
 import time
+import timeit
 import weakref
+import zlib
 from typing import Callable, Optional
 
 import pyarrow as pa
@@ -30,6 +32,24 @@ from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
 logger = setup_custom_logger(__name__)
+
+
+class SpillCorruption(RuntimeError):
+    """A spill file's bytes no longer match the CRC recorded at write
+    time (bad disk, torn write, bit rot)."""
+
+
+def _file_crc(path: str) -> int:
+    """CRC-32 of a file's bytes, streamed (the file was just written, so
+    this reads from page cache)."""
+    crc = 0
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            crc = zlib.crc32(chunk, crc)
+    return crc & 0xFFFFFFFF
 
 # Process-wide spill totals across every SpillManager, for assertions
 # and monitoring that must not depend on log level or manager lifetime
@@ -50,9 +70,21 @@ def process_spill_totals() -> "tuple[int, int]":
 class SpilledTable:
     """Lazy handle to one reducer output on disk.
 
-    ``load()`` memory-maps the IPC file, unlinks it (the mapping keeps the
-    pages alive on POSIX), accounts the bytes to the buffer ledger like
-    any in-flight table, and caches the result so repeated loads are safe.
+    ``load()`` verifies the file's CRC against the one recorded at write
+    time (end-to-end frame integrity: a spill that sat on a dying scratch
+    volume must not silently feed damaged rows to training), memory-maps
+    the IPC file, unlinks it (the mapping keeps the pages alive on
+    POSIX), accounts the bytes to the buffer ledger like any in-flight
+    table, and caches the result so repeated loads are safe.
+
+    A corrupt or unreadable spill is **recomputed from lineage** when the
+    writer supplied a ``recompute`` closure (the single-host reduce path
+    does — a reducer output is a pure function of ``(seed, epoch,
+    reducer)`` and the input files): the bad file is quarantined into a
+    structured ``QuarantinedFile`` report and the recompute, bounded by
+    the spill RetryPolicy, yields a bit-identical table. Without lineage
+    (the cross-host path, whose inputs crossed the wire) the failure
+    stays loud — there is no second copy.
 
     The handle holds its :class:`SpillManager` alive: the scratch
     directory is removed by the manager's finalizer only after the LAST
@@ -61,30 +93,78 @@ class SpilledTable:
     """
 
     __slots__ = ("_path", "num_rows", "_table", "_lock", "_manager",
-                 "__weakref__")
+                 "_crc", "_recompute", "_epoch", "_task", "__weakref__")
 
-    def __init__(self, path: str, num_rows: int, manager: "SpillManager"):
+    def __init__(self, path: str, num_rows: int, manager: "SpillManager",
+                 crc: Optional[int] = None,
+                 recompute: Optional[Callable[[], pa.Table]] = None,
+                 epoch: Optional[int] = None, task: Optional[int] = None):
         self._path = path
         self.num_rows = num_rows
         self._table: Optional[pa.Table] = None
         self._lock = threading.Lock()
         self._manager = manager
+        self._crc = crc
+        self._recompute = recompute
+        self._epoch = epoch
+        self._task = task
         # A handle dropped without ever being loaded (abandoned run)
         # deletes its file; idempotent with load()'s unlink.
         weakref.finalize(self, _unlink_quiet, path)
 
+    def _read_back(self) -> pa.Table:
+        # Fault site: a spilled output that cannot be read back is lost
+        # data — recovered from lineage below when possible, loud
+        # otherwise.
+        rt_faults.inject("spill_read", epoch=self._epoch, task=self._task)
+        if self._crc is not None and _file_crc(self._path) != self._crc:
+            raise SpillCorruption(
+                f"spill file {self._path} failed its CRC check "
+                f"(bytes changed since the write)")
+        with pa.memory_map(self._path) as source:
+            return pa.ipc.open_file(source).read_all()
+
     def load(self) -> pa.Table:
+        from ray_shuffling_data_loader_tpu import stats as stats_mod
+        from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
         from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
         with self._lock:
             if self._table is None:
-                # Fault site: a spilled output that cannot be read back is
-                # lost data — this must fail the consumer loudly (there is
-                # no second copy; the in-memory table was dropped when the
-                # handle replaced it).
-                rt_faults.inject("spill_read")
-                with trace_span("spill_load", kind="spill_read"):
-                    with pa.memory_map(self._path) as source:
-                        self._table = pa.ipc.open_file(source).read_all()
+                with trace_span("spill_load", kind="spill_read",
+                                epoch=self._epoch, task=self._task):
+                    try:
+                        self._table = self._read_back()
+                    except (OSError, pa.ArrowInvalid, SpillCorruption,
+                            rt_faults.InjectedFault) as e:
+                        if self._recompute is None:
+                            raise
+                        # Quarantine + lineage recompute: the corrupt
+                        # file is reported (never silent), then the
+                        # reducer output is rebuilt from its pure
+                        # (seed, epoch, reducer) lineage — bit-identical
+                        # by the determinism contract.
+                        report = rt_faults.QuarantinedFile(
+                            filename=self._path,
+                            epoch=self._epoch if self._epoch is not None
+                            else -1,
+                            file_index=self._task if self._task is not None
+                            else -1,
+                            error=f"{type(e).__name__}: {e}")
+                        stats_mod.fault_stats().record_quarantine(report)
+                        logger.error(
+                            "spill read-back failed (%s); quarantined %s "
+                            "and recomputing reducer output from lineage",
+                            e, self._path)
+                        start = timeit.default_timer()
+                        retry = rt_retry.RetryPolicy.for_component("spill")
+                        self._table = retry.call(
+                            self._recompute,
+                            describe=f"spill recompute e{self._epoch} "
+                                     f"r{self._task}")
+                        assert self._table.num_rows == self.num_rows, (
+                            self._table.num_rows, self.num_rows)
+                        stats_mod.fault_stats().record_recompute(
+                            "spill", timeit.default_timer() - start)
                 _unlink_quiet(self._path)
                 from ray_shuffling_data_loader_tpu import native
                 native.account_table(self._table)
@@ -119,9 +199,16 @@ class SpillManager:
         self.spilled_bytes = 0
         weakref.finalize(self, shutil.rmtree, self._dir, True)
 
-    def maybe_spill(self, table: pa.Table):
+    def maybe_spill(self, table: pa.Table, recompute=None,
+                    epoch: Optional[int] = None,
+                    task: Optional[int] = None):
         """Spill ``table`` if the pipeline is over its transient budget;
-        returns the table itself or a :class:`SpilledTable` handle."""
+        returns the table itself or a :class:`SpilledTable` handle.
+
+        ``recompute`` (a zero-arg closure rebuilding this exact table
+        from its deterministic lineage) arms the handle's
+        corrupt-read-back recovery; ``epoch``/``task`` key the handle's
+        fault site and quarantine report."""
         # Snapshot: report() may detach the predicate concurrently (driver
         # finishing while a caller-owned pool still runs reduce tasks).
         over_budget = self._over_budget
@@ -152,6 +239,15 @@ class SpillManager:
             _unlink_quiet(path)
             return table
         size = os.path.getsize(path)
+        # CRC recorded at write time, verified at load: the read-back is
+        # the only copy, so integrity must be end-to-end, not assumed.
+        try:
+            crc = _file_crc(path)
+        except OSError as e:
+            logger.warning("spill CRC read failed (%s); keeping reducer "
+                           "output in memory", e)
+            _unlink_quiet(path)
+            return table
         with self._lock:
             self.spill_count += 1
             self.spilled_bytes += size
@@ -164,7 +260,8 @@ class SpillManager:
                            "reducer outputs spilled to disk").inc()
         rt_metrics.counter("rsdl_spilled_bytes_total",
                            "bytes of reducer output spilled").inc(size)
-        return SpilledTable(path, table.num_rows, self)
+        return SpilledTable(path, table.num_rows, self, crc=crc,
+                            recompute=recompute, epoch=epoch, task=task)
 
     def report(self) -> None:
         """Log spill totals and detach the budget predicate.

@@ -666,7 +666,42 @@ TRIAL_FIELDNAMES = [
     "p95_map_read_ms", "p95_reduce_ms", "p95_queue_wait_ms",
     "p95_fetch_ms", "p95_convert_ms", "p95_device_transfer_ms",
     "p95_train_step_ms",
+    # Process-crash recovery totals (multiqueue_service v2 +
+    # runtime/supervisor.py; process totals at write time): frames
+    # re-sent from the server replay buffer, supervised queue-server
+    # restarts, and consumer-lease expiries.
+    "queue_frames_replayed", "queue_server_restarts",
+    "queue_lease_expiries",
 ]
+
+
+def _counter_total(name: str) -> int:
+    """Process-lifetime total of a registry counter (0 if never made)."""
+    family = rt_metrics.get(name)
+    if family is None:
+        return 0
+    if hasattr(family, "children"):
+        return int(sum(m.value for m in family.children().values()))
+    return int(family.value)
+
+
+def process_recovery_totals() -> Dict[str, int]:
+    """Queue-service crash-recovery counters (monotonic; snapshot
+    before/after a run — the ``watchdog_stats`` protocol)."""
+    return {
+        "queue_frames_replayed": _counter_total(
+            "rsdl_queue_frames_replayed_total"),
+        "queue_server_restarts": _counter_total(
+            "rsdl_queue_server_restarts_total"),
+        "queue_lease_expiries": _counter_total(
+            "rsdl_queue_lease_expiries_total"),
+        "queue_frames_nacked": _counter_total(
+            "rsdl_queue_frames_nacked_total"),
+        "queue_frames_corrupt": _counter_total(
+            "rsdl_queue_frames_corrupt_total"),
+        "queue_client_reconnects": _counter_total(
+            "rsdl_queue_client_reconnects_total"),
+    }
 
 EPOCH_FIELDNAMES = [
     "num_files", "num_row_groups_per_file", "num_reducers", "num_trainers",
@@ -746,6 +781,7 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
 
     wd = watchdog_stats().snapshot()
     fs = fault_stats().snapshot()
+    recovery = process_recovery_totals()
     verdict = rt_telemetry.attribution().run_summary() or {}
     verdict_stages = verdict.get("stages", {})
 
@@ -768,6 +804,9 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
             row["fault_recoveries_exhausted"] = fs["exhausted"]
             row["bottleneck_stage"] = verdict.get("bottleneck_stage", "")
             row["telemetry_stall_pct"] = verdict.get("stall_pct", 0.0)
+            row["queue_frames_replayed"] = recovery["queue_frames_replayed"]
+            row["queue_server_restarts"] = recovery["queue_server_restarts"]
+            row["queue_lease_expiries"] = recovery["queue_lease_expiries"]
             for stage in rt_telemetry.STAGES:
                 row[f"p95_{stage}_ms"] = verdict_stages.get(
                     stage, {}).get("p95_ms", 0.0)

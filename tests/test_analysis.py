@@ -309,6 +309,36 @@ WALLCLOCK_OK = """
         return time.monotonic() - start
 """
 
+SOCKET_TIMEOUT_BAD = """
+    import socket
+
+    def serve(address):
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.bind(address)
+        listener.listen(4)
+        conn, peer = listener.accept()  # blocks forever on a wedged peer
+        return conn.recv(1024)
+"""
+
+SOCKET_TIMEOUT_OK = """
+    import socket
+
+    def serve(address, timeout_s):
+        listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        listener.settimeout(1.0)
+        listener.bind(address)
+        listener.listen(4)
+        conn, peer = listener.accept()
+        # settimeout(None) would also count: an EXPLICIT infinite wait
+        # is a reviewed decision, the silent default is the bug.
+        conn.settimeout(timeout_s)
+        return conn.recv(1024)
+
+    def dial(address):
+        sock = socket.create_connection(address, timeout=30)
+        return sock.recv(4)
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -326,6 +356,7 @@ CASES = [
     ("unbounded-retry", RETRY_FIXED_SLEEP_BAD, RETRY_OK, {}),
     ("wallclock-interval", WALLCLOCK_DIRECT_BAD, WALLCLOCK_OK, {}),
     ("wallclock-interval", WALLCLOCK_VAR_BAD, WALLCLOCK_OK, {}),
+    ("socket-op-no-timeout", SOCKET_TIMEOUT_BAD, SOCKET_TIMEOUT_OK, {}),
 ]
 
 

@@ -168,6 +168,19 @@ def render(parsed: dict, before: dict = None, interval_s: float = None
     if stalls or faults:
         lines.append(f"watchdog stalls: {int(stalls)}   "
                      f"faults injected: {int(faults)}")
+    # Queue-service consumer health (multiqueue_service leases): live
+    # consumers, expired leases (dead trainers), and crash-recovery
+    # activity — the operator's first question when ranks go quiet.
+    alive = _scalar(parsed, "rsdl_queue_consumers_alive")
+    expiries = _scalar(parsed, "rsdl_queue_lease_expiries_total")
+    replayed = _scalar(parsed, "rsdl_queue_frames_replayed_total")
+    restarts = _scalar(parsed, "rsdl_queue_server_restarts_total")
+    if alive or expiries or replayed or restarts:
+        lines.append(
+            f"consumers: {int(alive)} alive   "
+            f"dead (lease expired): {int(expiries)}   "
+            f"frames replayed: {int(replayed)}   "
+            f"server restarts: {int(restarts)}")
     return "\n".join(lines)
 
 
