@@ -89,11 +89,30 @@ flight-recorded (SIGUSR1 dumps the event ring + named-thread stacks at
 any moment), and the JSON carries the bottleneck verdict computed from
 recorder events — ``bottleneck_stage``, ``telemetry_stall_pct``,
 ``stage_latency_ms`` (p50/p95/p99 per stage), ``telemetry_events``,
-and ``telemetry_overhead_pct`` (events x measured per-record cost over
-the timed window; contract <= 2%). RSDL_METRICS_FILE /
-RSDL_METRICS_PORT bring up the Prometheus exposition so
-``tools/rsdl_top.py`` can watch the run live; see
+and ``telemetry_overhead_pct`` (events x SELF-MEASURED full-path
+per-record cost over the timed window; contract <= 1%), plus
+``telemetry_overhead_off_pct`` — the same event count priced at the
+RSDL_TELEMETRY=0 hard-off fast path, the proof the off switch is ~free.
+RSDL_METRICS_FILE / RSDL_METRICS_PORT bring up the Prometheus
+exposition so ``tools/rsdl_top.py`` can watch the run live; see
 examples/observability.md.
+
+Causal trace + profiling (runtime/trace.py, runtime/profiler.py): the
+record also carries the critical-path attribution computed from the
+recorder's retained events — ``critical_path`` (per-stage critical-path
+ms, descending), ``self_time_ms`` (per-stage busy-union), ``whatif``
+("2x faster <stage> => -X% epoch time", monotone in the speedup), and
+``trace_straggler`` (the (stage, task) with the largest critical-path
+share). RSDL_TRACE_DIR makes every process dump its recorder for
+``tools/rsdl_trace.py`` to merge; RSDL_PROFILER=1 /
+RSDL_PROFILE_FOLDED=<path> engage the stdlib sampling profiler and add
+a ``profile`` summary (stage-billed samples, per-thread CPU seconds,
+hottest stacks; folded stacks land at the path — flamegraph-ready).
+
+Regression gate: ``--baseline <BENCH_rN.json>`` compares this record
+against the chosen committed baseline with tools/rsdl_bench_diff.py's
+thresholds and exits non-zero on a breach — the r03 -> r05 ingest
+regression class can no longer land silently.
 """
 
 from __future__ import annotations
@@ -693,6 +712,30 @@ def run_train(jax, filenames, *, num_epochs, batch_size, num_reducers,
     }
 
 
+def _baseline_from_invocation() -> "str | None":
+    """``--baseline PATH`` / ``--baseline=PATH`` argv flag (or
+    RSDL_BENCH_BASELINE): the committed bench record this run must not
+    regress from."""
+    argv = sys.argv[1:]
+    for i, arg in enumerate(argv):
+        if arg == "--baseline" and i + 1 < len(argv):
+            return argv[i + 1]
+        if arg.startswith("--baseline="):
+            return arg.split("=", 1)[1]
+    return os.environ.get("RSDL_BENCH_BASELINE") or None
+
+
+def _load_bench_diff():
+    """tools/rsdl_bench_diff.py as a module (tools/ is not a package)."""
+    import importlib.util
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "tools", "rsdl_bench_diff.py")
+    spec = importlib.util.spec_from_file_location("_rsdl_bench_diff", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
 def _chaos_rate_from_invocation() -> "float | None":
     """``--chaos`` / ``--chaos=RATE`` argv flag or RSDL_BENCH_CHAOS_RATE."""
     rate = None
@@ -991,7 +1034,9 @@ def main() -> None:
 
     from ray_shuffling_data_loader_tpu import stats as rsdl_stats
     from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+    from ray_shuffling_data_loader_tpu.runtime import profiler as rt_profiler
     from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_tel
+    from ray_shuffling_data_loader_tpu.runtime import trace as rt_trace
     from ray_shuffling_data_loader_tpu.utils.tracing import maybe_profile
 
     # Telemetry spine: the whole invocation is flight-recorded (SIGUSR1
@@ -1044,7 +1089,9 @@ def main() -> None:
             qname=qname, max_inflight_bytes=max_inflight_bytes,
             spill_dir=spill_dir)
 
-    with maybe_profile():
+    # Host-side sampling profiler next to the JAX device profiler: one
+    # window, two views (RSDL_PROFILER=1 / RSDL_PROFILE_FOLDED=<path>).
+    with maybe_profile(), rt_profiler.maybe_sample() as sampling_prof:
         if "cached" in phases:
             cached = _phase("cached", lambda: _ingest(
                 "bench-cached", cold=False, epochs=num_epochs))
@@ -1261,9 +1308,22 @@ def main() -> None:
     events_delta = rt_tel.recorder().total_recorded - events_before
     timed_s = sum(p["duration_s"] for p in (cached, cold, train) if p)
     record["telemetry_events"] = events_delta
+    record["telemetry_enabled"] = rt_tel.enabled()
     record["telemetry_overhead_pct"] = (
         round(100.0 * events_delta * telemetry_per_event_s / timed_s, 4)
         if timed_s else 0.0)
+    # The RSDL_TELEMETRY=0 hard-off fast path, priced at THIS run's
+    # event volume: the proof the off switch costs ~nothing (with
+    # telemetry off, events_delta itself is ~0 and both fields pin to 0).
+    record["telemetry_overhead_off_pct"] = (
+        round(100.0 * events_delta * rt_tel.measure_disabled_overhead()
+              / timed_s, 6) if timed_s else 0.0)
+    # Causal critical-path attribution over the recorder's retained
+    # events (runtime/trace.py): which stages/tasks the epochs actually
+    # waited on, and what a 2x speedup of each would buy.
+    record.update(rt_trace.bench_fields(rt_tel.recorder().events()))
+    if sampling_prof is not None:
+        record["profile"] = sampling_prof.summary()
     if chaos_rate is not None or any(fs_delta.values()):
         # Chaos <-> telemetry correlation: a fault event (kind = the
         # fault-site name) is JOINABLE when a non-fault telemetry event
@@ -1360,6 +1420,24 @@ def main() -> None:
               f"{record['replayed_frames']} frames replayed, "
               f"{record['lease_expiries']} lease expiries",
               file=sys.stderr)
+
+    # Regression gate (--baseline <BENCH_rN.json>): compare THIS record
+    # against the chosen committed baseline; a threshold breach fails
+    # the invocation so an r03->r05-class throughput drop is loud.
+    baseline_path = _baseline_from_invocation()
+    if baseline_path:
+        diff_mod = _load_bench_diff()
+        findings = diff_mod.compare_records(
+            diff_mod.load_record(baseline_path), record)
+        for line in diff_mod.render_findings(findings):
+            print(f"# bench-diff: {line}", file=sys.stderr)
+        regressions = [f for f in findings if not f["ok"]]
+        if regressions:
+            print(f"# bench-diff FAILED vs {baseline_path}: "
+                  f"{len(regressions)} metric(s) regressed",
+                  file=sys.stderr)
+            sys.exit(1)
+        print(f"# bench-diff OK vs {baseline_path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -35,4 +35,14 @@ else
     echo "-- rsdl-lint deps not importable, skipping"
 fi
 
+# Bench regression check (tools/rsdl_bench_diff.py, stdlib-only): when
+# committed bench records are present, compare the two newest and print
+# the per-metric verdict. Check mode is informational (rc 0) — the hard
+# gates are `bench.py --baseline <record>` at measurement time and the
+# two-file CLI form in CI.
+if ls BENCH_r*.json >/dev/null 2>&1; then
+    echo "-- rsdl-bench-diff (check mode)"
+    python tools/rsdl_bench_diff.py --check .
+fi
+
 echo "OK"

@@ -322,16 +322,20 @@ class ShufflingDataset:
             # Epoch-tagged queue wait: this is where a consumer blocks
             # when the shuffle cannot keep up — the "queue_wait" stage
             # of the bottleneck decomposition (the queue layer's own
-            # queue_get events have no epoch identity).
-            wait_start = timeit.default_timer()
-            if get_positioned is not None:
-                ref, row_offset = get_positioned(queue_idx)
-            else:
-                ref = self._batch_queue.get(queue_idx, block=True)
-                row_offset = None
-            rt_telemetry.record(
-                "queue_wait", epoch=self._epoch, task=queue_idx,
-                dur_s=timeit.default_timer() - wait_start)
+            # queue_get events have no epoch identity). Manual
+            # begin/end span so a get() that dies still records the
+            # time the consumer sat here (the span-unbalanced lint
+            # rule pins the finally shape).
+            wait_span = rt_telemetry.span_begin(
+                "queue_wait", epoch=self._epoch, task=queue_idx)
+            try:
+                if get_positioned is not None:
+                    ref, row_offset = get_positioned(queue_idx)
+                else:
+                    ref = self._batch_queue.get(queue_idx, block=True)
+                    row_offset = None
+            finally:
+                rt_telemetry.span_end(wait_span)
             if ref is None:
                 break
             if isinstance(ref, ShuffleFailure):

@@ -49,8 +49,14 @@ op                    fields
 
 Responses are ``(u32 count)`` followed by ``count`` frames of
 ``(u8 kind, u32 epoch, u32 seq, u32 crc32, u64 row_offset, u64 length,
-payload)`` with kind 0=table IPC stream, 1=epoch-end sentinel,
-2=shuffle-failure (payload = error text). ``seq`` is a per-queue
+u32 task, payload)`` with kind 0=table IPC stream, 1=epoch-end
+sentinel, 2=shuffle-failure (payload = error text). ``task`` is the
+producing reduce task's lineage id (``0xFFFFFFFF`` = unknown), read
+from the ``rsdl.trace`` schema metadata the reducer stamped on its
+output — the cross-process causal-trace context (runtime/trace.py):
+the consumer records it per frame, so a merged trace joins this
+frame's fetch to the exact server-side reduce span that built it.
+``seq`` is a per-queue
 monotonic frame number (stable across server restarts — restored from
 the delivered-watermark journal); ``crc32`` covers the payload bytes
 (zlib CRC-32), so corruption anywhere on the wire or in a replayed
@@ -114,7 +120,11 @@ logger = setup_custom_logger(__name__)
 
 _REQUEST = struct.Struct("<BBIII")
 _BATCH_HEADER = struct.Struct("<I")
-_FRAME = struct.Struct("<BIIIQQ")
+_FRAME = struct.Struct("<BIIIQQI")
+
+#: Frame ``task`` value for payloads with no lineage metadata
+#: (sentinels, failure frames, tables from a non-reduce producer).
+TASK_NONE = 0xFFFFFFFF
 
 OP_GET_BATCH = 1
 OP_HELLO = 2
@@ -157,30 +167,48 @@ def _serialize(table: pa.Table) -> pa.Buffer:
     return sink.getvalue()
 
 
-def _item_frame(item) -> Tuple[int, bytes, int]:
-    """Convert one queued item into a ``(kind, payload, num_rows)`` frame."""
+def _producer_task(table: pa.Table) -> int:
+    """Producing reduce task from the ``rsdl.trace`` schema metadata the
+    reducer stamped (``"seed:epoch:task"``); TASK_NONE when absent."""
+    meta = table.schema.metadata
+    if not meta:
+        return TASK_NONE
+    raw = meta.get(b"rsdl.trace")
+    if not raw:
+        return TASK_NONE
+    try:
+        return int(raw.rsplit(b":", 1)[-1])
+    except ValueError:
+        return TASK_NONE
+
+
+def _item_frame(item) -> Tuple[int, bytes, int, int]:
+    """Convert one queued item into a ``(kind, payload, num_rows, task)``
+    frame — ``task`` carries the producer's lineage id onto the wire."""
     if item is None:
-        return KIND_SENTINEL, b"", 0
+        return KIND_SENTINEL, b"", 0, TASK_NONE
     if isinstance(item, ShuffleFailure):
-        return KIND_FAILURE, repr(item.error).encode(), 0
+        return KIND_FAILURE, repr(item.error).encode(), 0, TASK_NONE
     try:
         table = item.result() if hasattr(item, "result") else item
         from ray_shuffling_data_loader_tpu import spill
         table = spill.unwrap(table)
-        return KIND_TABLE, _serialize(table), table.num_rows
+        return (KIND_TABLE, _serialize(table), table.num_rows,
+                _producer_task(table))
     except Exception as e:  # noqa: BLE001 - forwarded
         # A failed shuffle task ref: the consumer gets the real cause as
         # a failure frame, not a dead socket.
-        return KIND_FAILURE, repr(e).encode(), 0
+        return KIND_FAILURE, repr(e).encode(), 0, TASK_NONE
 
 
 class _Frame:
     """One serialized response frame held in the server replay buffer."""
 
     __slots__ = ("seq", "kind", "epoch", "payload", "crc", "row_offset",
-                 "nrows")
+                 "nrows", "task")
 
-    def __init__(self, seq, kind, epoch, payload, crc, row_offset, nrows):
+    def __init__(self, seq, kind, epoch, payload, crc, row_offset, nrows,
+                 task=TASK_NONE):
         self.seq = seq
         self.kind = kind
         self.epoch = epoch
@@ -188,6 +216,7 @@ class _Frame:
         self.crc = crc
         self.row_offset = row_offset
         self.nrows = nrows
+        self.task = task
 
     @property
     def size(self) -> int:
@@ -435,7 +464,7 @@ class QueueServer:
                     return None if not frames else frames
                 if item is _POP_EMPTY:
                     break
-                kind, payload, nrows = _item_frame(item)
+                kind, payload, nrows, task = _item_frame(item)
                 seq = state.next_seq
                 state.next_seq += 1
                 row_offset = state.rows_total
@@ -447,7 +476,8 @@ class QueueServer:
                     state.acked_rows = row_offset + nrows
                     continue
                 frame = _Frame(seq, kind, self._epoch_of(queue_idx),
-                               payload, _crc(payload), row_offset, nrows)
+                               payload, _crc(payload), row_offset, nrows,
+                               task)
                 state.replay.append(frame)
                 state.replay_bytes += frame.size
                 frames.append(frame)
@@ -461,7 +491,8 @@ class QueueServer:
         for frame in frames:
             size = frame.size
             header = _FRAME.pack(frame.kind, frame.epoch, frame.seq,
-                                 frame.crc, frame.row_offset, size)
+                                 frame.crc, frame.row_offset, size,
+                                 frame.task)
             try:
                 rt_faults.inject("conn_reset_midframe", epoch=frame.epoch,
                                  task=queue_idx)
@@ -473,11 +504,17 @@ class QueueServer:
                 raise ConnectionError(
                     f"injected connection reset mid-frame: {e}") from e
             corrupt = False
-            try:
-                rt_faults.inject("frame_corrupt", epoch=frame.epoch,
-                                 task=queue_idx)
-            except rt_faults.InjectedFault:
-                corrupt = True
+            if size:
+                # Only payload frames are corruptible: firing the site
+                # on a zero-length sentinel would record an "injected"
+                # event with nothing on the wire to corrupt — the
+                # consumer sees a clean CRC and the chaos<->telemetry
+                # join (fault_events_joinable) loses the event.
+                try:
+                    rt_faults.inject("frame_corrupt", epoch=frame.epoch,
+                                     task=queue_idx)
+                except rt_faults.InjectedFault:
+                    corrupt = True
             conn.sendall(header)
             if size:
                 if corrupt:
@@ -914,9 +951,9 @@ class RemoteQueue:
                     frames = []
                     corrupt_seq = None
                     for _ in range(count):
-                        kind, epoch, seq, crc, row_offset, length = \
-                            _FRAME.unpack(_recv_exact(self._sock,
-                                                      _FRAME.size))
+                        (kind, epoch, seq, crc, row_offset, length,
+                         src_task) = _FRAME.unpack(
+                             _recv_exact(self._sock, _FRAME.size))
                         epoch_hint = epoch
                         payload = (_recv_exact(self._sock, length)
                                    if length else b"")
@@ -938,6 +975,15 @@ class RemoteQueue:
                                 "queue %d: frame %d failed CRC; NACKing",
                                 queue_index, seq)
                             continue
+                        if kind == KIND_TABLE and src_task != TASK_NONE:
+                            # Cross-process causal link: this frame's
+                            # payload was built by reduce task
+                            # ``src_task`` in the SERVER process — the
+                            # merged trace (runtime/trace.py) joins the
+                            # consumer-side fetch to that exact span by
+                            # (epoch, task).
+                            rt_telemetry.record("frame_recv", epoch=epoch,
+                                                task=src_task, seq=seq)
                         frames.append((kind, seq, row_offset, payload))
                     if corrupt_seq is not None:
                         self._sock.sendall(_REQUEST.pack(
@@ -1209,6 +1255,23 @@ def _serve_main(argv: List[str]) -> int:
         return 2
     with open(argv[1]) as f:
         config = json.load(f)
+
+    # The supervisor stops a child with SIGTERM; convert it into a
+    # normal SystemExit unwind so the finally below (and the atexit
+    # trace dump telemetry registers under RSDL_TRACE_DIR, which this
+    # child inherits through the environment) actually runs — a killed
+    # incarnation's flight recorder is exactly the evidence a merged
+    # cross-process trace needs from it.
+    import signal as _signal
+
+    def _on_sigterm(_signum, _frame):
+        raise SystemExit(0)
+
+    try:
+        _signal.signal(_signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):  # pragma: no cover - non-main thread
+        pass
+
     server, shuffle_result, queue = serve_pipeline(config)
     print(f"READY {server.address[1]}", flush=True)
     try:

@@ -161,7 +161,9 @@ def _reduce_task(reducer_index: int, seed: int, epoch: int,
     shuffled = sh.shuffle_reduce(reducer_index, seed, epoch, chunks,
                                  stats_collector, reduce_transform,
                                  gather_threads)
-    return sh.account_and_maybe_spill(shuffled, spill_manager)
+    return sh.account_and_maybe_spill(shuffled, spill_manager,
+                                      epoch=epoch, task=reducer_index,
+                                      seed=seed)
 
 
 def shuffle_epoch_distributed(epoch: int,

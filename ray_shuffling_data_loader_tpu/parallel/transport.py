@@ -417,6 +417,13 @@ class TcpTransport:
                     raise TransportError(
                         f"host {self.host_id} failed sending to peer {dest} "
                         f"(redial also failed: {e}): {first_err}")
+        # The frame's (epoch, reducer, file) tag IS the cross-host trace
+        # context; recording the send gives the merged trace both ends
+        # of the hop (the receiver records transport_recv with the same
+        # key — runtime/trace.py joins them).
+        rt_telemetry.record("transport_send", epoch=epoch, task=reducer,
+                            dur_s=time.monotonic() - send_start, dest=dest,
+                            nbytes=memoryview(payload).nbytes)
 
 
 def create_local_transports(world: int,

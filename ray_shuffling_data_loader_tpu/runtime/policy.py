@@ -83,6 +83,18 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     "telemetry": (True, _parse_bool),
     "telemetry_capacity": (4096, int),
     "telemetry_dump_dir": ("", str),
+    # Causal tracing (runtime/trace.py): when set, EVERY process dumps
+    # its flight recorder into this directory at exit (and dump()
+    # defaults there), so `tools/rsdl_trace.py <dir>` can merge the
+    # multi-process story. Child processes (supervised queue servers)
+    # inherit it through the environment.
+    "trace_dir": ("", str),
+    # Continuous sampling profiler (runtime/profiler.py): stdlib stack
+    # sampling over named threads + per-thread CPU attribution. Off by
+    # default; the interval bounds its overhead (~1 stack walk per
+    # thread per tick).
+    "profiler": (False, _parse_bool),
+    "profiler_interval_s": (0.01, float),
     # Batch-wait share of wall clock above which the per-epoch verdict
     # names a producer stage instead of train_step (the <=10% stall
     # contract's mirror image).

@@ -93,7 +93,7 @@ _WORK_STAGES: Tuple[str, ...] = ("map_read", "reduce", "fetch", "convert",
                                  "device_transfer")
 
 Event = Tuple[float, str, Optional[int], Optional[int], Optional[int],
-              Optional[float], Optional[dict]]
+              Optional[float], Optional[int], Optional[dict]]
 
 
 class FlightRecorder:
@@ -135,7 +135,7 @@ class FlightRecorder:
         for ev in raw:
             if ev is None:
                 continue
-            t_mono, kind, epoch, task, batch, dur_s, attrs = ev
+            t_mono, kind, epoch, task, batch, dur_s, tid, attrs = ev
             d: Dict[str, Any] = {"t_mono": t_mono, "kind": kind}
             if epoch is not None:
                 d["epoch"] = epoch
@@ -145,6 +145,8 @@ class FlightRecorder:
                 d["batch"] = batch
             if dur_s is not None:
                 d["dur_s"] = dur_s
+            if tid is not None:
+                d["tid"] = tid
             if attrs:
                 d.update(attrs)
             out.append(d)
@@ -303,6 +305,57 @@ _recorder: Optional[FlightRecorder] = None
 _attribution: Optional[StageAttribution] = None
 _events_counter_cache: Dict[str, metrics.Counter] = {}
 _stage_hist_cache: Dict[str, metrics.Histogram] = {}
+#: thread ident -> currently-open span kind (the sampling profiler
+#: reads this to bill stack samples to pipeline stages). Plain-dict
+#: writes are GIL-atomic; no lock on the hot path.
+_active_kinds: Dict[int, str] = {}
+#: Lineage seed of the run this process participates in (trace.py's
+#: deterministic trace/span ids derive from it). Stamped into dumps.
+_trace_seed: Optional[int] = None
+_exit_dump_registered = False
+
+
+def _apply_enabled_locked() -> None:
+    """Swap the public entry points between the real implementations and
+    no-ops: the RSDL_TELEMETRY=0 hard-off fast path. Every caller uses
+    module-attribute access (``rt_telemetry.record(...)``), so the swap
+    takes effect process-wide; the disabled cost is one no-op call
+    (bench proves it via :func:`measure_disabled_overhead`)."""
+    g = globals()
+    if _ENABLED:
+        g["record"] = _record_impl
+        g["span"] = _span_impl
+        g["span_begin"] = _span_begin_impl
+        g["span_end"] = _span_end_impl
+    else:
+        g["record"] = _noop_record
+        g["span"] = _noop_span
+        g["span_begin"] = _noop_span_begin
+        g["span_end"] = _noop_span_end
+
+
+def _register_exit_dump_locked() -> None:
+    """With a trace dir configured (RSDL_TRACE_DIR), every process dumps
+    its recorder there at interpreter exit — the per-process half of the
+    multi-process merge contract (tools/rsdl_trace.py). The dir is
+    re-resolved at fire time so a scene that unsets the env after its
+    run leaves no stray dump."""
+    global _exit_dump_registered
+    if _exit_dump_registered:
+        return
+    _exit_dump_registered = True
+    import atexit
+
+    def _exit_dump() -> None:
+        from ray_shuffling_data_loader_tpu.runtime import policy
+        if not policy.resolve("telemetry", "trace_dir"):
+            return
+        try:
+            dump(reason="atexit")
+        except OSError:
+            logger.exception("telemetry exit dump failed")
+
+    atexit.register(_exit_dump)
 
 
 def _init_locked() -> None:
@@ -315,6 +368,9 @@ def _init_locked() -> None:
         capacity=int(policy.resolve("telemetry", "telemetry_capacity")))
     _attribution = StageAttribution(stall_threshold_pct=policy.resolve(
         "telemetry", "bottleneck_stall_threshold_pct"))
+    _apply_enabled_locked()
+    if policy.resolve("telemetry", "trace_dir"):
+        _register_exit_dump_locked()
 
 
 def recorder() -> FlightRecorder:
@@ -351,16 +407,38 @@ def configure(enabled_flag: Optional[bool] = None,
                            override=capacity)))
         _attribution = StageAttribution(stall_threshold_pct=policy.resolve(
             "telemetry", "bottleneck_stall_threshold_pct"))
+        _apply_enabled_locked()
+        if policy.resolve("telemetry", "trace_dir"):
+            _register_exit_dump_locked()
 
 
-def record(kind: str, epoch: Optional[int] = None,
-           task: Optional[int] = None, batch: Optional[int] = None,
-           dur_s: Optional[float] = None, t: Optional[float] = None,
-           **attrs: Any) -> None:
+def set_trace_seed(seed: int) -> None:
+    """Declare the lineage seed this process's run derives from. The
+    deterministic trace/span ids (runtime/trace.py) are functions of
+    ``(seed, epoch, task)``; stamping the seed here puts it into every
+    dump's meta so offline merges can re-derive the same ids the other
+    processes used. Recorded once per distinct seed."""
+    global _trace_seed
+    if _trace_seed == seed:
+        return
+    _trace_seed = seed
+    record("trace_meta", seed=seed)
+
+
+def trace_seed() -> Optional[int]:
+    return _trace_seed
+
+
+def _record_impl(kind: str, epoch: Optional[int] = None,
+                 task: Optional[int] = None, batch: Optional[int] = None,
+                 dur_s: Optional[float] = None, t: Optional[float] = None,
+                 **attrs: Any) -> None:
     """Record one structured event (free when telemetry is disabled).
 
     ``t`` is the event's END in ``time.monotonic()`` terms (defaults to
-    now); events with ``dur_s`` therefore span ``[t - dur_s, t]``.
+    now); events with ``dur_s`` therefore span ``[t - dur_s, t]``. The
+    recording thread's ident rides along so multi-thread traces export
+    with real tids (Perfetto pid/tid mapping).
     """
     if not _ENABLED:
         return
@@ -370,7 +448,8 @@ def record(kind: str, epoch: Optional[int] = None,
         if not _ENABLED:
             return
     now = time.monotonic() if t is None else t
-    rec.record((now, kind, epoch, task, batch, dur_s, attrs or None))
+    rec.record((now, kind, epoch, task, batch, dur_s,
+                threading.get_ident(), attrs or None))
     events_counter = _events_counter_cache.get(kind)
     if events_counter is None:
         events_counter = _events_counter_cache[kind] = metrics.counter(
@@ -400,27 +479,140 @@ def record(kind: str, epoch: Optional[int] = None,
 
 
 @contextlib.contextmanager
-def span(kind: str, epoch: Optional[int] = None, task: Optional[int] = None,
-         batch: Optional[int] = None, **attrs: Any) -> Iterator[None]:
+def _span_impl(kind: str, epoch: Optional[int] = None,
+               task: Optional[int] = None, batch: Optional[int] = None,
+               **attrs: Any) -> Iterator[None]:
     """Record the enclosed block as one duration event (disabled: the
-    overhead is the generator frame alone)."""
+    overhead is the generator frame alone). While open, the thread's
+    active kind is published for the sampling profiler's stage
+    attribution (runtime/profiler.py)."""
     if not _ENABLED:
         yield
         return
+    ident = threading.get_ident()
+    prev = _active_kinds.get(ident)
+    _active_kinds[ident] = kind
     start = time.monotonic()
     try:
         yield
     finally:
         end = time.monotonic()
+        if prev is None:
+            _active_kinds.pop(ident, None)
+        else:
+            _active_kinds[ident] = prev
         record(kind, epoch=epoch, task=task, batch=batch,
                dur_s=end - start, t=end, **attrs)
 
 
+def _span_begin_impl(kind: str, epoch: Optional[int] = None,
+                     task: Optional[int] = None,
+                     batch: Optional[int] = None,
+                     **attrs: Any) -> Optional[tuple]:
+    """Open a span that cannot be a ``with`` block (a wait measured
+    across loop iterations, a handoff between threads). Returns an
+    opaque token for :func:`span_end` — which MUST run on all exit
+    paths (``finally``); the ``span-unbalanced`` rsdl-lint rule enforces
+    the shape."""
+    if not _ENABLED:
+        return None
+    ident = threading.get_ident()
+    prev = _active_kinds.get(ident)
+    _active_kinds[ident] = kind
+    return (kind, epoch, task, batch, attrs, time.monotonic(), prev, ident)
+
+
+def _span_end_impl(token: Optional[tuple], **late_attrs: Any) -> None:
+    """Close a :func:`span_begin` token, recording the duration event.
+    ``None`` tokens (telemetry disabled at begin time) are a no-op, so
+    callers never need to guard."""
+    if token is None:
+        return
+    kind, epoch, task, batch, attrs, start, prev, ident = token
+    if prev is None:
+        _active_kinds.pop(ident, None)
+    else:
+        _active_kinds[ident] = prev
+    end = time.monotonic()
+    if late_attrs:
+        attrs = {**attrs, **late_attrs}
+    record(kind, epoch=epoch, task=task, batch=batch,
+           dur_s=end - start, t=end, **attrs)
+
+
+def active_kinds() -> Dict[int, str]:
+    """Snapshot of thread ident -> currently-open span kind."""
+    return dict(_active_kinds)
+
+
+# -- RSDL_TELEMETRY=0 hard-off fast path: the public names rebind to
+# these no-ops (one call frame, no env lookup, no branch chain).
+
+def _noop_record(kind: str, *args: Any, **kwargs: Any) -> None:
+    return None
+
+
+_NULL_SPAN = contextlib.nullcontext()
+
+
+def _noop_span(kind: str, *args: Any, **kwargs: Any):
+    return _NULL_SPAN
+
+
+def _noop_span_begin(*args: Any, **kwargs: Any) -> None:
+    return None
+
+
+def _noop_span_end(token: Any = None, **kwargs: Any) -> None:
+    return None
+
+
+# Public entry points (swapped by _apply_enabled_locked when policy
+# resolves telemetry off).
+record = _record_impl
+span = _span_impl
+span_begin = _span_begin_impl
+span_end = _span_end_impl
+
+
+def _update_trace_gauges(epoch: int) -> None:
+    """Per-epoch critical-path exposition (tools/rsdl_top.py's
+    critical-path line): run the trace analyzer over the recorder's
+    retained events for this epoch and publish per-stage critical-path
+    seconds plus the top straggler. Best-effort — exposition must never
+    take down the pipeline."""
+    try:
+        from ray_shuffling_data_loader_tpu.runtime import trace as rt_trace
+        analysis = rt_trace.analyze(recorder().events(), epoch=epoch)
+        for entry in analysis["critical_path"]:
+            metrics.gauge(
+                "rsdl_trace_cp_seconds",
+                "critical-path seconds attributed to the stage "
+                "(latest analyzed epoch)",
+                stage=entry["stage"]).set(entry["cp_ms"] / 1e3)
+        stragglers = [s for s in analysis["stragglers"]
+                      if s["cp_ms"] > 0 and s["task"] is not None]
+        if stragglers:
+            top = stragglers[0]
+            metrics.gauge(
+                "rsdl_trace_straggler_task",
+                "task id of the current critical-path straggler",
+                stage=top["stage"]).set(float(top["task"]))
+            metrics.gauge(
+                "rsdl_trace_straggler_seconds",
+                "critical-path seconds of the current straggler task",
+                stage=top["stage"]).set(top["cp_ms"] / 1e3)
+    except Exception:  # noqa: BLE001 - observability stays best-effort
+        logger.exception("trace gauge update failed (epoch %d)", epoch)
+
+
 def epoch_complete(epoch: int, source: str = "") -> None:
-    """Epoch-end hook for dataset layers: logs the one-line verdict."""
+    """Epoch-end hook for dataset layers: logs the one-line verdict and
+    refreshes the critical-path exposition gauges."""
     if not _ENABLED:
         return
     attribution().epoch_complete(epoch, source=source)
+    _update_trace_gauges(epoch)
 
 
 # ---------------------------------------------------------------------------
@@ -439,6 +631,7 @@ def _thread_stacks() -> List[Dict[str, Any]]:
         out.append({
             "kind": "thread_stack",
             "thread": thread.name if thread else f"ident-{ident}",
+            "ident": ident,
             "daemon": bool(thread.daemon) if thread else None,
             "stack": buf.getvalue().rstrip().splitlines(),
         })
@@ -456,7 +649,8 @@ def dump(path: Optional[str] = None, reason: str = "on-demand") -> str:
     if path is None:
         from ray_shuffling_data_loader_tpu.runtime import policy
         import tempfile
-        directory = (policy.resolve("telemetry", "telemetry_dump_dir")
+        directory = (policy.resolve("telemetry", "trace_dir")
+                     or policy.resolve("telemetry", "telemetry_dump_dir")
                      or tempfile.gettempdir())
         os.makedirs(directory, exist_ok=True)
         with _lock:
@@ -468,12 +662,15 @@ def dump(path: Optional[str] = None, reason: str = "on-demand") -> str:
     with open(path, "w", encoding="utf-8") as f:
         # time.time() here is a SERIALIZED timestamp (never used in
         # interval math): it anchors t_mono offsets to wall clock for
-        # whoever reads the dump.
+        # whoever reads the dump — the cross-process clock alignment
+        # runtime/trace.py merges on.
         f.write(json.dumps({
             "kind": "dump_meta", "reason": reason, "pid": os.getpid(),
             "time_unix": time.time(), "t_mono": time.monotonic(),
             "events_total": rec.total_recorded,
             "events_retained": min(rec.total_recorded, rec.capacity),
+            "trace_seed": _trace_seed,
+            "role": os.path.basename(sys.argv[0]) or "python",
         }) + "\n")
         for event in rec.events():
             f.write(json.dumps(event) + "\n")
@@ -509,17 +706,35 @@ def install_signal_dump(signum: int = signal.SIGUSR1) -> bool:
 
 
 def measure_record_overhead(samples: int = 2000) -> float:
-    """Seconds per ``record()`` call, measured against a throwaway ring
-    (the live recorder is not polluted). Bench multiplies this by the
-    events recorded in its timed window to report the recorder's share
-    of the ingest path."""
+    """Seconds per ENABLED ``record()`` call, measured against throwaway
+    doubles of everything the real path touches — ring, events counter,
+    stage histogram, attribution observe — so the number is the full
+    per-event cost, not just the ring append (the live recorder is not
+    polluted). Bench multiplies this by the events recorded in its
+    timed window: the self-measured ``telemetry_overhead_pct``."""
     probe = FlightRecorder(capacity=256)
-    hist = metrics.Histogram()
+    probe_counter = metrics.Counter()
+    probe_attr = StageAttribution()
+    probe_hist = metrics.Histogram()
     start = time.perf_counter()
     for i in range(samples):
         now = time.monotonic()
-        probe.record((now, "probe", 0, i, None, 1e-6, None))
-        hist.observe(1e-6)
+        probe.record((now, "probe", 0, i, None, 1e-6,
+                      threading.get_ident(), None))
+        probe_counter.inc()
+        probe_attr.observe("map_read", 0, 1e-6, now)
+        probe_hist.observe(1e-6)
+    elapsed = time.perf_counter() - start
+    return elapsed / samples
+
+
+def measure_disabled_overhead(samples: int = 2000) -> float:
+    """Seconds per call of the RSDL_TELEMETRY=0 hard-off fast path (the
+    no-op ``record`` the public name rebinds to). Bench reports it as
+    ``telemetry_overhead_off_pct`` — the proof the off switch is ~free."""
+    start = time.perf_counter()
+    for i in range(samples):
+        _noop_record("probe", epoch=0, task=i, dur_s=1e-6)
     elapsed = time.perf_counter() - start
     return elapsed / samples
 

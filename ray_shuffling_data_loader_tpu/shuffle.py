@@ -937,12 +937,14 @@ def _reduce_task(reduce_index: int, seed: int, epoch: int,
             on_recovery=_recovered)
     return account_and_maybe_spill(shuffled, spill_manager,
                                    recompute=spill_recompute,
-                                   epoch=epoch, task=reduce_index)
+                                   epoch=epoch, task=reduce_index,
+                                   seed=seed)
 
 
 def account_and_maybe_spill(shuffled: pa.Table, spill_manager,
                             recompute=None, epoch: Optional[int] = None,
-                            task: Optional[int] = None) -> pa.Table:
+                            task: Optional[int] = None,
+                            seed: Optional[int] = None) -> pa.Table:
     """Post-reduce memory policy, shared by the single-host and distributed
     reduce wrappers so their semantics cannot diverge: charge the output's
     in-flight bytes to the buffer ledger (plasma's store-utilization role;
@@ -953,7 +955,20 @@ def account_and_maybe_spill(shuffled: pa.Table, spill_manager,
     host path: :func:`recompute_reducer_output` bound to this reducer's
     lineage) arms the handle's corrupt-spill recovery; the cross-host
     path passes None — its inputs crossed the wire, so a corrupt spill
-    there stays a loud failure."""
+    there stays a loud failure.
+
+    The output is also stamped with its lineage as ``rsdl.trace``
+    schema metadata (``"seed:epoch:task"``) — the causal trace context
+    (runtime/trace.py). Schema metadata survives slicing, Arrow IPC
+    (spill files, the queue wire, the transport) and concatenation, so
+    whichever process ends up holding this table can name the exact
+    reduce span that built it; the queue service copies the task id
+    into its v2 frame headers from here."""
+    if epoch is not None and task is not None:
+        meta = dict(shuffled.schema.metadata or {})
+        meta[b"rsdl.trace"] = f"{seed if seed is not None else 0}:" \
+                              f"{epoch}:{task}".encode()
+        shuffled = shuffled.replace_schema_metadata(meta)
     from ray_shuffling_data_loader_tpu import native
     native.account_table(shuffled)
     if spill_manager is not None:
@@ -1123,6 +1138,10 @@ def shuffle(filenames: Sequence[str],
             num_epochs, num_maps=len(filenames), num_reduces=num_reducers,
             num_consumes=num_trainers)
         stats_collector.trial_start()
+    # Causal-trace context: every id this run's spans carry derives from
+    # (seed, epoch, task); stamping the seed puts it into recorder dumps
+    # so offline merges re-derive the same ids (runtime/trace.py).
+    rt_telemetry.set_trace_seed(seed)
     start = timeit.default_timer()
 
     # Caching only pays when a file is mapped more than once.

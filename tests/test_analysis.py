@@ -339,6 +339,40 @@ SOCKET_TIMEOUT_OK = """
         return sock.recv(4)
 """
 
+SPAN_NO_END_BAD = """
+    from ray_shuffling_data_loader_tpu.runtime import telemetry
+
+    def drain(queue, epoch):
+        token = telemetry.span_begin("queue_wait", epoch=epoch)
+        item = queue.get()  # a raising get() loses the span forever
+        return item
+"""
+
+SPAN_NO_FINALLY_BAD = """
+    from ray_shuffling_data_loader_tpu.runtime import telemetry
+
+    def drain(queue, epoch):
+        token = telemetry.span_begin("queue_wait", epoch=epoch)
+        item = queue.get()
+        telemetry.span_end(token)  # skipped when get() raises
+        return item
+"""
+
+SPAN_BALANCED_OK = """
+    from ray_shuffling_data_loader_tpu.runtime import telemetry
+
+    def drain(queue, epoch):
+        token = telemetry.span_begin("queue_wait", epoch=epoch)
+        try:
+            return queue.get()
+        finally:
+            telemetry.span_end(token)
+
+    def open_wait_span(epoch):
+        # Token handed to the caller: the close obligation moves with it.
+        return telemetry.span_begin("queue_wait", epoch=epoch)
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -357,6 +391,8 @@ CASES = [
     ("wallclock-interval", WALLCLOCK_DIRECT_BAD, WALLCLOCK_OK, {}),
     ("wallclock-interval", WALLCLOCK_VAR_BAD, WALLCLOCK_OK, {}),
     ("socket-op-no-timeout", SOCKET_TIMEOUT_BAD, SOCKET_TIMEOUT_OK, {}),
+    ("span-unbalanced", SPAN_NO_END_BAD, SPAN_BALANCED_OK, {}),
+    ("span-unbalanced", SPAN_NO_FINALLY_BAD, SPAN_BALANCED_OK, {}),
 ]
 
 

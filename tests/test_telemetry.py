@@ -43,7 +43,7 @@ def fresh_telemetry():
 def test_ring_buffer_overwrites_oldest_keeps_order():
     rec = telemetry.FlightRecorder(capacity=8)
     for i in range(20):
-        rec.record((float(i), "k", None, i, None, None, None))
+        rec.record((float(i), "k", None, i, None, None, None, None))
     assert rec.total_recorded == 20
     events = rec.events()
     assert len(events) == 8
@@ -54,7 +54,7 @@ def test_ring_buffer_overwrites_oldest_keeps_order():
 def test_ring_buffer_partial_fill_in_order():
     rec = telemetry.FlightRecorder(capacity=16)
     for i in range(5):
-        rec.record((float(i), "k", 0, i, None, 0.1, {"x": i}))
+        rec.record((float(i), "k", 0, i, None, 0.1, None, {"x": i}))
     events = rec.events()
     assert [e["task"] for e in events] == [0, 1, 2, 3, 4]
     assert events[0]["x"] == 0 and events[0]["dur_s"] == 0.1

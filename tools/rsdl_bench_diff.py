@@ -1,0 +1,205 @@
+#!/usr/bin/env python
+"""rsdl-bench-diff: per-metric regression gate between two bench records.
+
+BENCH_r05 regressed cached ingest from BENCH_r03's 26.2M rows/s to
+9.2M and NOTHING in the repo noticed — the record format carries the
+numbers but no machinery compared them. This tool is that machinery:
+
+    tools/rsdl_bench_diff.py BENCH_r03.json BENCH_r05.json
+        # rc 1, 'value ... REGRESSED' — the r03->r05 drop, flagged
+
+    tools/rsdl_bench_diff.py --check [DIR]
+        # informational mode for format.sh: compares the two newest
+        # committed BENCH_r*.json records, prints the verdict, rc 0
+        # (add --strict to make it a hard gate)
+
+    python bench.py --baseline BENCH_r03.json
+        # the hard gate at measurement time: bench loads this module
+        # and exits non-zero on a threshold breach
+
+Records are either a raw bench JSON line (``{"metric", "value", ...}``)
+or the committed ``BENCH_r*.json`` wrapper (``{"parsed": {...}}``) —
+both load. Only metrics present in BOTH records are compared (schemas
+grew over rounds), except ceilings, which apply to the current record
+alone. Thresholds are relative drops/rises chosen to sit above host
+noise (the committed records span 1-core and many-core hosts) but far
+below the regressions worth catching; override any of them with
+``--threshold key=pct``.
+
+Exit codes: 0 clean, 1 regression (two-file mode / --strict), 2 usage.
+Stdlib-only (runs on a bare CI image, the rsdl_top pattern).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+#: (key, mode, threshold). Modes:
+#:   lower_bad:  fail when cur < base * (1 - pct/100)
+#:   higher_bad: fail when cur > base * (1 + pct/100) AND cur - base
+#:               exceeds the absolute slack in ``slack`` (noise floor)
+#:   ceiling:    fail when cur > threshold (current record alone)
+DEFAULT_RULES: List[Dict[str, Any]] = [
+    {"key": "value", "mode": "lower_bad", "pct": 10.0},
+    {"key": "rows_per_s_per_core", "mode": "lower_bad", "pct": 10.0},
+    {"key": "cold_rows_per_sec", "mode": "lower_bad", "pct": 10.0},
+    {"key": "train_rows_per_sec", "mode": "lower_bad", "pct": 10.0},
+    {"key": "train_mfu_pct", "mode": "lower_bad", "pct": 10.0},
+    {"key": "stall_pct_under_train", "mode": "higher_bad", "pct": 20.0,
+     "slack": 2.0},
+    {"key": "fill_s", "mode": "higher_bad", "pct": 50.0, "slack": 1.0},
+    {"key": "telemetry_overhead_pct", "mode": "ceiling", "limit": 1.0},
+]
+
+
+def load_record(path: str) -> Dict[str, Any]:
+    """A bench record from disk: raw bench JSON or BENCH_r* wrapper."""
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if isinstance(data, dict) and isinstance(data.get("parsed"), dict):
+        return data["parsed"]
+    if not isinstance(data, dict) or "value" not in data:
+        raise ValueError(f"{path}: not a bench record "
+                         "(no 'value' and no 'parsed' wrapper)")
+    return data
+
+
+def _num(record: Dict[str, Any], key: str) -> Optional[float]:
+    value = record.get(key)
+    if isinstance(value, bool) or not isinstance(value, (int, float)):
+        return None
+    return float(value)
+
+
+def compare_records(base: Dict[str, Any], cur: Dict[str, Any],
+                    overrides: Optional[Dict[str, float]] = None
+                    ) -> List[Dict[str, Any]]:
+    """Apply the rule table; returns one finding dict per applicable
+    rule: ``{key, mode, base, cur, delta_pct, threshold_pct, ok,
+    reason}``. ``overrides`` replaces a rule's pct/limit by key."""
+    overrides = overrides or {}
+    findings: List[Dict[str, Any]] = []
+    for rule in DEFAULT_RULES:
+        key, mode = rule["key"], rule["mode"]
+        threshold = overrides.get(key, rule.get("pct", rule.get("limit")))
+        cur_v = _num(cur, key)
+        if cur_v is None:
+            continue
+        if mode == "ceiling":
+            ok = cur_v <= threshold
+            findings.append({
+                "key": key, "mode": mode, "base": None, "cur": cur_v,
+                "delta_pct": None, "threshold_pct": threshold, "ok": ok,
+                "reason": (f"{cur_v:g} <= ceiling {threshold:g}" if ok
+                           else f"{cur_v:g} exceeds ceiling {threshold:g}"),
+            })
+            continue
+        base_v = _num(base, key)
+        if base_v is None or base_v == 0:
+            continue
+        delta_pct = 100.0 * (cur_v - base_v) / base_v
+        if mode == "lower_bad":
+            ok = delta_pct >= -threshold
+        else:  # higher_bad: relative rise AND absolute slack both breached
+            slack = rule.get("slack", 0.0)
+            ok = delta_pct <= threshold or (cur_v - base_v) <= slack
+        findings.append({
+            "key": key, "mode": mode, "base": base_v, "cur": cur_v,
+            "delta_pct": round(delta_pct, 2), "threshold_pct": threshold,
+            "ok": ok,
+            "reason": f"{base_v:g} -> {cur_v:g} ({delta_pct:+.1f}%, "
+                      f"threshold {'-' if mode == 'lower_bad' else '+'}"
+                      f"{threshold:g}%)",
+        })
+    return findings
+
+
+def render_findings(findings: List[Dict[str, Any]]) -> List[str]:
+    lines = []
+    for f in findings:
+        verdict = "ok        " if f["ok"] else "REGRESSED "
+        lines.append(f"{verdict}{f['key']:<26} {f['reason']}")
+    if not findings:
+        lines.append("no comparable metrics between the two records")
+    return lines
+
+
+def _latest_records(directory: str) -> List[str]:
+    paths = sorted(glob.glob(os.path.join(directory, "BENCH_r*.json")))
+    return paths[-2:]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        description="per-metric regression gate between two bench records")
+    parser.add_argument("baseline", nargs="?",
+                        help="baseline record (e.g. BENCH_r03.json)")
+    parser.add_argument("current", nargs="?",
+                        help="current record (e.g. BENCH_r05.json)")
+    parser.add_argument("--check", metavar="DIR", nargs="?", const=".",
+                        default=None,
+                        help="informational mode: compare the two newest "
+                             "BENCH_r*.json in DIR (default .), rc 0")
+    parser.add_argument("--strict", action="store_true",
+                        help="with --check: regressions exit non-zero")
+    parser.add_argument("--threshold", action="append", default=[],
+                        metavar="KEY=PCT",
+                        help="override one rule's threshold, repeatable")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable findings")
+    args = parser.parse_args(argv)
+
+    overrides: Dict[str, float] = {}
+    for spec in args.threshold:
+        if "=" not in spec:
+            parser.error(f"--threshold wants KEY=PCT, got {spec!r}")
+        key, pct = spec.split("=", 1)
+        try:
+            overrides[key] = float(pct)
+        except ValueError:
+            parser.error(f"--threshold {spec!r}: {pct!r} is not a number")
+
+    if args.check is not None:
+        latest = _latest_records(args.check)
+        if len(latest) < 2:
+            print(f"bench-diff check: fewer than two BENCH_r*.json in "
+                  f"{args.check!r}; nothing to compare")
+            return 0
+        base_path, cur_path = latest
+        hard = args.strict
+    else:
+        if not args.baseline or not args.current:
+            parser.error("need BASELINE and CURRENT records "
+                         "(or --check [DIR])")
+        base_path, cur_path = args.baseline, args.current
+        hard = True
+
+    try:
+        base = load_record(base_path)
+        cur = load_record(cur_path)
+    except (OSError, ValueError) as e:
+        print(f"bench-diff: {e}", file=sys.stderr)
+        return 2
+
+    findings = compare_records(base, cur, overrides)
+    regressions = [f for f in findings if not f["ok"]]
+    if args.json:
+        print(json.dumps({"baseline": base_path, "current": cur_path,
+                          "findings": findings,
+                          "regressed": len(regressions)}))
+    else:
+        print(f"bench-diff: {base_path} -> {cur_path}")
+        for line in render_findings(findings):
+            print(f"  {line}")
+        if regressions:
+            print(f"  {len(regressions)} metric(s) REGRESSED")
+    return 1 if regressions and hard else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -181,6 +181,30 @@ def render(parsed: dict, before: dict = None, interval_s: float = None
             f"dead (lease expired): {int(expiries)}   "
             f"frames replayed: {int(replayed)}   "
             f"server restarts: {int(restarts)}")
+    # Critical-path line (runtime/trace.py gauges, refreshed per epoch):
+    # the top-3 stages by critical-path self time plus the current
+    # straggler task — the "what do I optimize" one-liner.
+    cp = [(dict(labels).get("stage"), value) for labels, value in
+          parsed.get("rsdl_trace_cp_seconds", {}).items()]
+    cp = sorted(((s, v) for s, v in cp if s), key=lambda kv: -kv[1])[:3]
+    if cp:
+        line = "critical path: " + " > ".join(
+            f"{stage} {value:.2f}s" for stage, value in cp)
+        strag = [(dict(labels).get("stage"), value) for labels, value in
+                 parsed.get("rsdl_trace_straggler_seconds", {}).items()]
+        strag = sorted(((s, v) for s, v in strag if s),
+                       key=lambda kv: -kv[1])
+        if strag:
+            stage = strag[0][0]
+            task = None
+            for labels, value in parsed.get("rsdl_trace_straggler_task",
+                                            {}).items():
+                if dict(labels).get("stage") == stage:
+                    task = int(value)
+            line += (f"   straggler: {stage}"
+                     + (f" task {task}" if task is not None else "")
+                     + f" ({strag[0][1]:.2f}s)")
+        lines.append(line)
     return "\n".join(lines)
 
 
