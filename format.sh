@@ -35,6 +35,20 @@ else
     echo "-- rsdl-lint deps not importable, skipping"
 fi
 
+# Stage microbenchmarks (tools/rsdl_microbench.py): per-kernel numbers
+# (parquet decode, partition plan, fused gather, shm IPC handoff) in
+# informational mode, so a kernel-level regression surfaces before the
+# next full bench round. Always rc 0; the hard gate stays bench.py
+# --baseline. RSDL_MICROBENCH=0 skips it (costs a few seconds).
+if [ "${RSDL_MICROBENCH:-1}" != "0" ]; then
+    if python -c 'import pyarrow, numpy' 2>/dev/null; then
+        echo "-- rsdl-microbench (check mode)"
+        python tools/rsdl_microbench.py --check >/dev/null
+    else
+        echo "-- rsdl-microbench deps not importable, skipping"
+    fi
+fi
+
 # Bench regression check (tools/rsdl_bench_diff.py, stdlib-only): when
 # committed bench records are present, compare the two newest and print
 # the per-metric verdict. Check mode is informational (rc 0) — the hard

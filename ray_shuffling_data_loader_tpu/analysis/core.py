@@ -144,7 +144,7 @@ def all_rules() -> Dict[str, Rule]:
     """The registry, with the built-in rule modules imported."""
     from ray_shuffling_data_loader_tpu.analysis import (  # noqa: F401
         rules_arrow, rules_executor, rules_hygiene, rules_jax, rules_lock,
-        rules_runtime, rules_telemetry)
+        rules_perf, rules_runtime, rules_telemetry)
     return dict(_REGISTRY)
 
 

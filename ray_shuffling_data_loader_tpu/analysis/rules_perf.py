@@ -1,0 +1,94 @@
+"""Hot-path copy-discipline rules.
+
+The shuffle's throughput story is built on zero-copy Arrow handoff: map
+outputs are index plans over mmap-able tables, the fused reduce gathers
+straight from source buffers, and the process backend hands whole tables
+across processes as shared-memory segments. One careless conversion in a
+hot path silently re-materializes the very bytes the design avoids
+copying — and the regression shows up only as a throughput drift nobody
+can attribute (the r03 -> r05 ingest regression was exactly such a
+drift).
+
+``copy-in-hot-path`` pins the discipline in the three hot-path modules
+(``shuffle.py``, ``dataset.py``, ``jax_dataset.py``):
+
+- ``.astype(...)`` without ``copy=False`` — NumPy copies even when the
+  dtype already matches; ``copy=False`` makes the no-op case free and
+  documents that a copy is conditional, not assumed.
+- ``.to_numpy(zero_copy_only=False)`` — permission to copy on every
+  call. Legitimate only at the blessed conversion sites whose results
+  are cached (``_table_numpy_columns`` behind ``MapShard``'s per-shard
+  cache, the device-conversion boundary in ``jax_dataset``); those carry
+  a pragma with their justification.
+- ``.combine_chunks()`` — concatenates every chunk into fresh buffers.
+  Blessed only where the copy is paid ONCE and amortized (the decode
+  path right before a table enters a cross-epoch cache); per-call sites
+  must operate on the chunked form instead.
+
+Escape hatch: ``# rsdl-lint: disable=copy-in-hot-path`` on the line (or
+the line above), with the justification in prose next to it — the
+pragma IS the blessing mechanism.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ray_shuffling_data_loader_tpu.analysis.core import (FileContext, Rule,
+                                                         Violation,
+                                                         get_keyword,
+                                                         is_constant,
+                                                         register)
+
+#: Repo-relative path globs of the hot-path modules the rule covers.
+#: (fnmatch ``*`` crosses directories, so ``*/dataset.py`` matches the
+#: module at any depth but NOT ``jax_dataset.py`` — no ``/`` precedes
+#: its ``dataset.py`` suffix.)
+HOT_PATH_GLOBS = ("*/shuffle.py", "shuffle.py",
+                  "*/dataset.py", "dataset.py",
+                  "*/jax_dataset.py", "jax_dataset.py")
+
+
+@register
+class CopyInHotPathRule(Rule):
+    id = "copy-in-hot-path"
+    category = "perf"
+    description = ("flag copying conversions (.astype without copy=False, "
+                   ".to_numpy(zero_copy_only=False), .combine_chunks()) in "
+                   "the shuffle/dataset hot-path modules outside blessed "
+                   "cached sites")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        if not ctx.path_matches(HOT_PATH_GLOBS):
+            return
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)):
+                continue
+            method = node.func.attr
+            if method == "astype":
+                copy_kw = get_keyword(node, "copy")
+                if not is_constant(copy_kw, False):
+                    yield ctx.violation(
+                        self, node,
+                        "hot-path .astype() without copy=False copies even "
+                        "when the dtype already matches; pass copy=False "
+                        "(or bless the site with a pragma + justification)")
+            elif method == "to_numpy":
+                zco = get_keyword(node, "zero_copy_only")
+                if is_constant(zco, False):
+                    yield ctx.violation(
+                        self, node,
+                        "hot-path to_numpy(zero_copy_only=False) permits a "
+                        "copy on every call; only blessed cached conversion "
+                        "sites may carry it (pragma + justification)")
+            elif method == "combine_chunks" and not node.args \
+                    and not node.keywords:
+                yield ctx.violation(
+                    self, node,
+                    "hot-path combine_chunks() concatenates every chunk "
+                    "into fresh buffers; bless only once-per-cache-entry "
+                    "sites (pragma + justification) — per-call sites must "
+                    "stay chunked")

@@ -83,15 +83,20 @@ def wait(refs: Sequence[TaskRef],
     done_refs: List[TaskRef] = []
     satisfied: set = set()
     while num_returns > 0 and len(satisfied) < num_returns:
-        remaining = [f for f in pending if f not in satisfied]
+        # Satisfied futures were dropped from `pending` below, so every
+        # wake scans only the still-live futures — a large fan-out's
+        # worst case is one pass per completion over the survivors, not
+        # O(n^2) rebuilds of the full list.
         budget = (None if deadline is None
                   else max(0.0, deadline - time.monotonic()))
         finished, _ = cf.wait(
-            remaining, timeout=budget,
+            pending.keys(), timeout=budget,
             return_when=cf.ALL_COMPLETED
-            if num_returns - len(satisfied) == len(remaining)
+            if num_returns - len(satisfied) == len(pending)
             else cf.FIRST_COMPLETED)
         satisfied.update(finished)
+        for future in finished:
+            pending.pop(future, None)
         if deadline is not None and time.monotonic() >= deadline:
             break
     for ref in refs:  # stable order
@@ -100,6 +105,29 @@ def wait(refs: Sequence[TaskRef],
     done_set = set(id(r) for r in done_refs)
     not_done = [r for r in refs if id(r) not in done_set]
     return done_refs, not_done
+
+
+# Last shuffle-worker pool created in this process (bench honesty
+# fields): the bench record must report the EFFECTIVE data-plane width
+# and backend, not os.cpu_count() — a 1-wide pool on a 96-core host must
+# not claim 96-way normalization (ISSUE 7 satellite). Only real worker
+# pools register; single-thread driver/utility pools do not.
+_pool_info_lock = threading.Lock()
+_last_pool_info = {"backend": None, "workers": None, "pids": []}
+
+
+def note_worker_pool(backend: str, workers: int, pids: Sequence[int]) -> None:
+    """Record the most recent worker pool's effective shape."""
+    with _pool_info_lock:
+        _last_pool_info.update(backend=backend, workers=workers,
+                               pids=list(pids))
+
+
+def last_worker_pool() -> dict:
+    """``{backend, workers, pids}`` of the most recent worker pool (the
+    bench record's executor_* fields); ``backend`` None if none yet."""
+    with _pool_info_lock:
+        return dict(_last_pool_info)
 
 
 class Executor:
@@ -154,10 +182,21 @@ class Executor:
         self._pool = cf.ThreadPoolExecutor(
             max_workers=num_workers, thread_name_prefix=thread_name_prefix)
         self._shutdown = False
+        if thread_name_prefix == "rsdl-worker":
+            note_worker_pool("thread", num_workers, [os.getpid()])
+
+    #: Data-plane discriminator (procpool.ProcessPoolExecutor says
+    #: "process"); shuffle_epoch and the bench record key off it.
+    backend = "thread"
 
     @property
     def num_workers(self) -> int:
         return self._num_workers
+
+    def worker_pids(self) -> List[int]:
+        """PIDs actually executing tasks — for the thread backend that is
+        this process alone (the bench record's honesty fields)."""
+        return [os.getpid()]
 
     def submit(self, fn: Callable, *args, **kwargs) -> TaskRef:
         if self._shutdown:

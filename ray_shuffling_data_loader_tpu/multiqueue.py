@@ -229,7 +229,10 @@ class MultiQueue:
         # never half-delivers an item (chaos keyed by queue index).
         rt_faults.inject("queue_put", task=queue_index)
         self._check_open()
-        start = time.monotonic()
+        # stamp() rebinds to a no-op with telemetry hard-off, so the
+        # per-item clock reads vanish along with the record call
+        # (hot-path audit, ISSUE 7).
+        start = rt_telemetry.stamp()
         try:
             self._queues[queue_index].put(item, block=block, timeout=timeout)
         except Full:
@@ -237,7 +240,7 @@ class MultiQueue:
         # Producer-side backpressure evidence: a long put means the
         # consumer (or a bounded queue) is the slow side.
         rt_telemetry.record("queue_put", task=queue_index,
-                            dur_s=time.monotonic() - start)
+                            dur_s=rt_telemetry.stamp() - start)
 
     def put_nowait(self, queue_index: int, item: Any) -> None:
         self.put(queue_index, item, block=False)
@@ -279,14 +282,14 @@ class MultiQueue:
         # Fault site: fires before the dequeue — no item is consumed, so
         # the caller may retry (or crash, for checkpoint-resume chaos).
         rt_faults.inject("queue_get", task=queue_index)
-        start = time.monotonic()
+        start = rt_telemetry.stamp()  # no-op clock read when hard-off
         try:
             item = self._queues[queue_index].get(block=block,
                                                  timeout=timeout)
         except Empty:
             raise Empty(f"queue {queue_index} is empty")
         rt_telemetry.record("queue_get", task=queue_index,
-                            dur_s=time.monotonic() - start)
+                            dur_s=rt_telemetry.stamp() - start)
         return item
 
     def get_nowait(self, queue_index: int) -> Any:

@@ -60,6 +60,9 @@ def _bind(lib: ctypes.CDLL) -> None:
                                   ctypes.POINTER(ctypes.c_double))
     lib.rsdl_partition_indices.argtypes = [u32p, i64, i64, i64p, i64p]
     lib.rsdl_partition_indices.restype = ctypes.c_int
+    lib.rsdl_plan_partition.argtypes = [i64, i64, u64, i64p, i64p,
+                                        ctypes.c_int]
+    lib.rsdl_plan_partition.restype = ctypes.c_int
     lib.rsdl_scatter_gather.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         i64, ctypes.c_int32, ctypes.c_int
@@ -161,6 +164,61 @@ def partition_indices(assignments: np.ndarray,
         raise ValueError(
             f"assignment value out of range for num_reducers={num_reducers}")
     return [out[offsets[r]:offsets[r + 1]] for r in range(num_reducers)]
+
+
+_GOLDEN = np.uint64(0x9e3779b97f4a7c15)
+_MIX_C1 = np.uint64(0xbf58476d1ce4e5b9)
+_MIX_C2 = np.uint64(0x94d049bb133111eb)
+
+
+def hash_assign(num_rows: int, num_reducers: int, key: int) -> np.ndarray:
+    """Vectorized splitmix64 per-row reducer assignment.
+
+    Bit-identical to the per-row hash inside the native
+    ``rsdl_plan_partition`` kernel (same constants, same stream layout:
+    ``mix64(key + (i+1) * golden) % num_reducers``), so the NumPy plan
+    fallback and the fused native plan produce the same partition on any
+    host. Counter-based on purpose: every row's draw is independent, which
+    is what lets the native kernel recompute assignments in its placement
+    pass instead of materializing them.
+    """
+    if num_reducers < 1:
+        raise ValueError(f"num_reducers must be >= 1, got {num_reducers}")
+    i = np.arange(1, num_rows + 1, dtype=np.uint64)
+    x = np.uint64(key & 0xFFFFFFFFFFFFFFFF) + i * _GOLDEN
+    x ^= x >> np.uint64(30)
+    x *= _MIX_C1
+    x ^= x >> np.uint64(27)
+    x *= _MIX_C2
+    x ^= x >> np.uint64(31)
+    return (x % np.uint64(num_reducers)).astype(np.uint32)
+
+
+def plan_partition_flat(num_rows: int, num_reducers: int, key: int,
+                        nthreads: int = 1
+                        ) -> "tuple[np.ndarray, np.ndarray]":
+    """Fused RNG -> stable counting-sort partition plan (native kernel).
+
+    Returns ``(indices, offsets)``: ``indices`` is a permutation of
+    ``arange(num_rows)`` grouped by reducer (original row order within a
+    group), ``offsets`` has ``num_reducers + 1`` entries delimiting the
+    groups. The assignment array is never materialized — the kernel
+    recomputes the per-row hash in its placement pass.
+    """
+    lib = _load()
+    assert lib is not None
+    indices = np.empty(num_rows, dtype=np.int64)
+    offsets = np.empty(num_reducers + 1, dtype=np.int64)
+    rc = lib.rsdl_plan_partition(
+        num_rows, num_reducers, key & 0xFFFFFFFFFFFFFFFF,
+        indices.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        max(1, nthreads))
+    if rc != 0:
+        raise ValueError(
+            f"invalid plan_partition arguments (num_rows={num_rows}, "
+            f"num_reducers={num_reducers})")
+    return indices, offsets
 
 
 def scatter_gather(src: np.ndarray, idx: Optional[np.ndarray],

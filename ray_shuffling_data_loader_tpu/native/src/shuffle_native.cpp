@@ -58,6 +58,104 @@ int rsdl_partition_indices(const uint32_t* assignments, int64_t n,
 }
 
 // ---------------------------------------------------------------------------
+// Fused partition plan: per-row RNG -> stable counting sort, one kernel
+// ---------------------------------------------------------------------------
+
+// The map stage's assign -> partition pipeline used to materialize a uint32
+// assignment array via a numpy Philox draw, cross the ctypes boundary, and
+// counting-sort it (rsdl_partition_indices) — three passes over n and two
+// kernel launches. This kernel fuses the stages: each row's reducer
+// assignment is a stateless splitmix64 hash of (key, row) computed in the
+// count pass and stashed in a scratch vector the placement pass re-reads
+// (4n scratch bytes stream through cache faster than a second round of
+// 64-bit multiplies). The hash is counter-based, so both passes parallelize
+// over contiguous row chunks and placement stays stable via per-(chunk,
+// reducer) cursors. The Python fallback (native/__init__.py hash_assign)
+// vectorizes the identical arithmetic, so native and NumPy plans are
+// bit-identical by construction.
+
+static inline uint64_t mix64(uint64_t x) {
+  x ^= x >> 30;
+  x *= 0xbf58476d1ce4e5b9ULL;
+  x ^= x >> 27;
+  x *= 0x94d049bb133111ebULL;
+  x ^= x >> 31;
+  return x;
+}
+
+static inline uint64_t row_assign(uint64_t key, int64_t i, uint64_t bound) {
+  // splitmix64 stream: state = key + (i+1) * golden ratio; output = mix.
+  // Modulo bias < 2^-40 for the reducer counts involved (same argument as
+  // rsdl_fill_random_int64).
+  return mix64(key + static_cast<uint64_t>(i + 1) * 0x9e3779b97f4a7c15ULL)
+         % bound;
+}
+
+int rsdl_plan_partition(int64_t n, int64_t num_reducers, uint64_t key,
+                        int64_t* out_indices, int64_t* out_offsets,
+                        int nthreads) {
+  if (num_reducers < 1 || n < 0) return -1;
+  if (nthreads < 1) nthreads = 1;
+  if (n < (1 << 16)) nthreads = 1;  // below this the spawn cost dominates
+  const uint64_t bound = static_cast<uint64_t>(num_reducers);
+  std::vector<uint32_t> assign(static_cast<size_t>(n));
+  // counts[chunk][reducer], chunk-major so the prefix walk below is cheap.
+  std::vector<std::vector<int64_t>> counts(
+      nthreads, std::vector<int64_t>(num_reducers, 0));
+  auto count_work = [&](int t) {
+    int64_t lo = n * t / nthreads, hi = n * (t + 1) / nthreads;
+    auto& local = counts[t];
+    for (int64_t i = lo; i < hi; ++i) {
+      uint32_t r = static_cast<uint32_t>(row_assign(key, i, bound));
+      assign[i] = r;
+      local[r]++;
+    }
+  };
+  if (nthreads == 1) {
+    count_work(0);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(nthreads);
+    for (int t = 0; t < nthreads; ++t) threads.emplace_back(count_work, t);
+    for (auto& th : threads) th.join();
+  }
+  out_offsets[0] = 0;
+  for (int64_t r = 0; r < num_reducers; ++r) {
+    int64_t total = 0;
+    for (int t = 0; t < nthreads; ++t) total += counts[t][r];
+    out_offsets[r + 1] = out_offsets[r] + total;
+  }
+  // cursor[chunk][reducer]: where chunk t's first row for reducer r lands —
+  // reducer start + rows earlier chunks contribute to r. Earlier chunks
+  // hold smaller row indices, so within a reducer the output stays in
+  // original row order (stability, same contract as rsdl_partition_indices).
+  std::vector<std::vector<int64_t>> cursor(
+      nthreads, std::vector<int64_t>(num_reducers, 0));
+  for (int64_t r = 0; r < num_reducers; ++r) {
+    int64_t at = out_offsets[r];
+    for (int t = 0; t < nthreads; ++t) {
+      cursor[t][r] = at;
+      at += counts[t][r];
+    }
+  }
+  auto place_work = [&](int t) {
+    int64_t lo = n * t / nthreads, hi = n * (t + 1) / nthreads;
+    auto& local = cursor[t];
+    for (int64_t i = lo; i < hi; ++i)
+      out_indices[local[assign[i]]++] = i;
+  };
+  if (nthreads == 1) {
+    place_work(0);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(nthreads);
+    for (int t = 0; t < nthreads; ++t) threads.emplace_back(place_work, t);
+    for (auto& th : threads) th.join();
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
 // Fused scatter-gather: out[dest[i]] = src[idx[i]]
 // ---------------------------------------------------------------------------
 

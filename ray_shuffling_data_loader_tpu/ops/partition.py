@@ -27,6 +27,35 @@ import numpy as np
 _MAP_STREAM = 0
 _REDUCE_STREAM = 1
 
+# Domain tag for the fused hash-partition stream (plan v2). Distinct from
+# the Philox spawn keys above by construction (different generator family),
+# but tagged anyway so future streams keyed off the same triple can't
+# collide with it.
+_PLAN_STREAM = 2
+
+_MASK64 = (1 << 64) - 1
+
+
+def _mix64(x: int) -> int:
+    """splitmix64 finalizer over Python ints (single values only — the
+    vectorized form lives in ``native.hash_assign``)."""
+    x &= _MASK64
+    x = ((x ^ (x >> 30)) * 0xbf58476d1ce4e5b9) & _MASK64
+    x = ((x ^ (x >> 27)) * 0x94d049bb133111eb) & _MASK64
+    return x ^ (x >> 31)
+
+
+def partition_key(seed: int, epoch: int, file_index: int) -> int:
+    """64-bit key for the fused partition plan of one map task.
+
+    Chained splitmix64 mixing of the ``(seed, epoch, file_index)`` lineage
+    triple — the same determinism contract as :func:`map_rng`, expressed as
+    one integer the native kernel (and its NumPy twin) can stream from.
+    """
+    key = _mix64((seed & _MASK64) ^ (_PLAN_STREAM << 56))
+    key = _mix64(key ^ ((epoch & _MASK64) * 0x9e3779b97f4a7c15))
+    return _mix64(key ^ ((file_index & _MASK64) * 0xc2b2ae3d27d4eb4f))
+
 
 def map_rng(seed: int, epoch: int, file_index: int) -> np.random.Generator:
     """PRNG for the map task of ``file_index`` in ``epoch``."""
@@ -80,6 +109,45 @@ def partition_indices_numpy(assignments: np.ndarray,
     order = np.argsort(assignments, kind="stable").astype(np.int64, copy=False)
     splits = np.cumsum(counts)[:-1]
     return [part for part in np.split(order, splits)]
+
+
+def plan_partition_flat(num_rows: int, num_reducers: int, seed: int,
+                        epoch: int, file_index: int, nthreads: int = 1
+                        ) -> "tuple[np.ndarray, np.ndarray]":
+    """Fused assign+partition plan: ``(flat_indices, offsets)``.
+
+    One kernel replaces the map task's two-stage assign (Philox draw) ->
+    partition (counting sort) pipeline: each row's reducer is a stateless
+    splitmix64 hash of ``(partition_key(seed, epoch, file_index), row)``
+    and the stable counting sort is fused around it, so the per-row
+    assignment array is never materialized on the native path. The NumPy
+    fallback vectorizes the identical hash, so the plan is bit-identical
+    with and without the native library — which is what lets the thread
+    and process executor backends (and recovery recomputes on either)
+    reproduce each other's shuffles exactly.
+    """
+    from ray_shuffling_data_loader_tpu import native
+    key = partition_key(seed, epoch, file_index)
+    if native.available():
+        return native.plan_partition_flat(num_rows, num_reducers, key,
+                                          nthreads=nthreads)
+    assignments = native.hash_assign(num_rows, num_reducers, key)
+    counts = np.bincount(assignments, minlength=num_reducers)
+    order = np.argsort(assignments, kind="stable").astype(np.int64,
+                                                          copy=False)
+    offsets = np.zeros(num_reducers + 1, dtype=np.int64)
+    np.cumsum(counts, out=offsets[1:])
+    return order, offsets
+
+
+def plan_partition(num_rows: int, num_reducers: int, seed: int, epoch: int,
+                   file_index: int, nthreads: int = 1) -> List[np.ndarray]:
+    """Per-reducer index arrays from the fused partition plan (the
+    list-of-parts view of :func:`plan_partition_flat`, shape-compatible
+    with :func:`partition_indices`)."""
+    flat, offsets = plan_partition_flat(num_rows, num_reducers, seed,
+                                        epoch, file_index, nthreads)
+    return [flat[offsets[r]:offsets[r + 1]] for r in range(num_reducers)]
 
 
 def permutation(num_rows: int, rng: np.random.Generator) -> np.ndarray:

@@ -124,6 +124,30 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     # producers are unblocked and memory is released), "redistribute"
     # (reroute its undelivered tables to a surviving consumer).
     "on_dead_consumer": ("fail_fast", str),
+    # Executor data-plane backend (executor.py / procpool.py): "thread"
+    # (GIL-releasing thread pool, the historical default), "process"
+    # (supervised worker subprocesses with shared-memory Arrow handoff),
+    # or "auto" (process when the host has >1 core, a writable shared-
+    # memory dir, and the workload's transforms are picklable; thread
+    # otherwise). shuffle() consults this only when it owns the pool.
+    "executor_backend": ("auto", str),
+    # Worker count for the pool (0 = one per host CPU).
+    "executor_workers": (0, int),
+    # Where process-backend shm segments live ("" = /dev/shm when
+    # writable, else the system temp dir — which silently degrades
+    # zero-copy to page-cache-backed mmap, still correct).
+    "executor_shm_dir": ("", str),
+    # Byte budget for decoded-table segments cached across epochs in the
+    # process backend's shm arena (0 = half the free bytes of the shm
+    # filesystem at pool creation).
+    "executor_shm_bytes": (0, int),
+    # Map-stage partition plan: "fused" (one native kernel emits
+    # partition indices straight from a counter-based splitmix64 stream;
+    # bit-identical NumPy fallback) or "philox" (legacy two-stage
+    # numpy Philox draw + counting sort). Both are deterministic in
+    # (seed, epoch, file); the streams differ, so flipping this knob
+    # mid-checkpoint changes the shuffle order.
+    "partition_plan": ("fused", str),
     # What shuffle_map does with a corrupt/unreadable input file after
     # read retries are exhausted: "raise" (fail the map task; lineage
     # recovery then retries it, and only exhausted recovery poisons the

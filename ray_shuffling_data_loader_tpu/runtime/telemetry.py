@@ -327,11 +327,13 @@ def _apply_enabled_locked() -> None:
         g["span"] = _span_impl
         g["span_begin"] = _span_begin_impl
         g["span_end"] = _span_end_impl
+        g["stamp"] = _stamp_impl
     else:
         g["record"] = _noop_record
         g["span"] = _noop_span
         g["span_begin"] = _noop_span_begin
         g["span_end"] = _noop_span_end
+        g["stamp"] = _noop_stamp
 
 
 def _register_exit_dump_locked() -> None:
@@ -545,6 +547,31 @@ def active_kinds() -> Dict[int, str]:
     return dict(_active_kinds)
 
 
+def observe_stage(kind: str, epoch: Optional[int] = None,
+                  task: Optional[int] = None, dur_s: float = 0.0) -> None:
+    """Feed the bottleneck attribution + stage histograms with a duration
+    measured in ANOTHER process.
+
+    The process-pool workers (procpool.py) record the real ``map_read`` /
+    ``reduce_gather`` events in their own flight recorders (dumped via
+    ``RSDL_TRACE_DIR``); re-recording them in the driver's ring would
+    double-count the spans when the per-process dumps are merged
+    (tools/rsdl_trace.py). This entry point updates only the driver-side
+    attribution state and latency histograms — no ring event.
+    """
+    if not _ENABLED:
+        return
+    stage = STAGE_BY_KIND.get(kind)
+    if stage is None:
+        return
+    attribution().observe(stage, epoch, dur_s, time.monotonic())
+    hist = _stage_hist_cache.get(stage)
+    if hist is None:
+        hist = _stage_hist_cache[stage] = metrics.histogram(
+            "rsdl_stage_seconds", "per-event stage latency", stage=stage)
+    hist.observe(dur_s)
+
+
 # -- RSDL_TELEMETRY=0 hard-off fast path: the public names rebind to
 # these no-ops (one call frame, no env lookup, no branch chain).
 
@@ -567,12 +594,30 @@ def _noop_span_end(token: Any = None, **kwargs: Any) -> None:
     return None
 
 
+def _stamp_impl() -> float:
+    """Clock read for hot-path duration measurement (``time.monotonic``).
+
+    PRs 4-6 put two clock reads on every queue put/get and wire frame —
+    true per-item fast paths. Under the hard-off rebind this name becomes
+    a constant-return no-op, so RSDL_TELEMETRY=0 strips the clock reads
+    along with the record calls (the r03->r05 hot-path audit, ISSUE 7):
+    ``start = stamp(); ...; record(kind, dur_s=stamp() - start)`` costs
+    two no-op calls when telemetry is off.
+    """
+    return time.monotonic()
+
+
+def _noop_stamp() -> float:
+    return 0.0
+
+
 # Public entry points (swapped by _apply_enabled_locked when policy
 # resolves telemetry off).
 record = _record_impl
 span = _span_impl
 span_begin = _span_begin_impl
 span_end = _span_end_impl
+stamp = _stamp_impl
 
 
 def _update_trace_gauges(epoch: int) -> None:

@@ -44,6 +44,7 @@ from ray_shuffling_data_loader_tpu import executor as ex
 from ray_shuffling_data_loader_tpu import stats as stats_mod
 from ray_shuffling_data_loader_tpu.ops import partition as ops
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
 from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
 from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.utils import fileio
@@ -75,6 +76,25 @@ ReduceTransform = Callable[[pa.Table], pa.Table]
 # 1-core host this is 1.
 import os as _os
 _SCATTER_GATHER_THREADS = max(1, min(4, (_os.cpu_count() or 1)))
+
+# Shared pool for per-column gather fan-out inside _fused_reduce. Lazy and
+# process-wide: reduce tasks from every concurrent epoch feed it leaf work
+# (no column task ever waits on another pool task, so it cannot deadlock at
+# any width).
+_column_pool = None
+_column_pool_lock = threading.Lock()
+
+
+def _column_gather_pool():
+    global _column_pool
+    if _column_pool is None:
+        with _column_pool_lock:
+            if _column_pool is None:
+                import concurrent.futures as _cf
+                _column_pool = _cf.ThreadPoolExecutor(
+                    max_workers=max(2, min(16, (_os.cpu_count() or 1))),
+                    thread_name_prefix="rsdl-gather-col")
+    return _column_pool
 
 
 def derive_gather_threads(concurrent_reduces: int, pool_workers: int,
@@ -145,8 +165,15 @@ def _table_numpy_columns(table: pa.Table) -> Optional[Dict[str, np.ndarray]]:
         if col.num_chunks == 0:
             cols[name] = np.empty(0, dtype=t.to_pandas_dtype())
             continue
-        combined = (col.chunk(0) if col.num_chunks == 1
-                    else col.combine_chunks())
+        if col.num_chunks == 1:
+            combined = col.chunk(0)
+        else:
+            # Blessed: runs once per shard (MapShard.numpy_columns' locked
+            # cache); cached tables are single-chunk, so steady state
+            # never reaches it. rsdl-lint: disable=copy-in-hot-path
+            combined = col.combine_chunks()
+        # Blessed: zero-copy for the single-chunk primitive columns this
+        # function admits; cached per shard. rsdl-lint: disable=copy-in-hot-path
         arr = combined.to_numpy(zero_copy_only=False)
         if arr.dtype == object:
             return None
@@ -470,6 +497,25 @@ class LazyChunk:
         return self.shard.table.take(self.indices)
 
 
+def plan_map_partition(num_rows: int, num_reducers: int, seed: int,
+                       epoch: int, file_index: int) -> List[np.ndarray]:
+    """The map task's row->reducer partition plan, policy-selected.
+
+    ``partition_plan="fused"`` (default) runs the one-kernel counter-based
+    plan (``ops.plan_partition``: the native kernel emits partition indices
+    straight from its hash stream; the NumPy fallback is bit-identical).
+    ``"philox"`` keeps the legacy two-stage draw+sort pipeline. Both
+    executor backends and every recovery recompute resolve the same knob,
+    so a given ``(seed, epoch, file)`` always replays the same plan.
+    """
+    if rt_policy.resolve("shuffle", "partition_plan") == "philox":
+        rng = ops.map_rng(seed, epoch, file_index)
+        assignments = ops.assign_reducers(num_rows, num_reducers, rng)
+        return ops.partition_indices(assignments, num_reducers)
+    return ops.plan_partition(num_rows, num_reducers, seed, epoch,
+                              file_index, nthreads=_SCATTER_GATHER_THREADS)
+
+
 def _read_map_table(filename: str, epoch: int, file_index: int,
                     read_retry: Optional[rt_retry.RetryPolicy]) -> pa.Table:
     """The map task's Parquet read, as one named fault site plus an
@@ -543,8 +589,9 @@ def shuffle_map(filename: str,
             if map_transform is not None:
                 table = map_transform(table)
             if file_cache is not None:
-                # Single-chunk columns => every later epoch's numpy views of
-                # this table are zero-copy.
+                # Blessed: paid once per CACHED file — single-chunk columns
+                # make every later epoch's numpy views zero-copy.
+                # rsdl-lint: disable=copy-in-hot-path
                 table = table.combine_chunks()
                 file_cache.put(filename, table)
             # Charge the decoded table to the buffer ledger for its
@@ -558,9 +605,8 @@ def shuffle_map(filename: str,
         # (kind, epoch, task).
         rt_telemetry.record("map_read", epoch=epoch, task=file_index,
                             dur_s=end_read - start)
-        rng = ops.map_rng(seed, epoch, file_index)
-        assignments = ops.assign_reducers(table.num_rows, num_reducers, rng)
-        index_parts = ops.partition_indices(assignments, num_reducers)
+        index_parts = plan_map_partition(table.num_rows, num_reducers,
+                                         seed, epoch, file_index)
         shard = MapShard(table, index_parts)
     if stats_collector is not None:
         stats_collector.map_done(epoch, timeit.default_timer() - start,
@@ -600,8 +646,19 @@ def _fused_reduce(reduce_index: int, seed: int, epoch: int,
                for cols, idx, n in sources]
     from ray_shuffling_data_loader_tpu import native
     use_native = native.available() and index_dtype == np.int32
-    out_cols = {}
-    for name in column_names:
+    threads = gather_threads or _SCATTER_GATHER_THREADS
+    names = list(column_names)
+    # Column fan-out: columns are independent gathers, so run them on the
+    # shared column pool and split this task's thread budget across the
+    # columns in flight — total concurrency stays at `threads`, but the
+    # per-column Python loop (slice bookkeeping, the numpy fallback arms)
+    # no longer serializes the whole reduce. Small outputs stay inline:
+    # below the native kernel's own threading floor the handoff costs more
+    # than it saves.
+    fan_out = min(len(names), threads) if total >= (1 << 16) else 1
+    col_threads = max(1, threads // fan_out)
+
+    def _gather_column(name: str) -> np.ndarray:
         dtype = sources[0][0][name].dtype
         out = np.empty(total, dtype=dtype)
         offset = 0
@@ -610,15 +667,23 @@ def _fused_reduce(reduce_index: int, seed: int, epoch: int,
             src = cols[name]
             if (use_native and src.flags.c_contiguous
                     and dtype.itemsize in (1, 2, 4, 8)):
-                native.scatter_gather(
-                    src, idx, dest, out,
-                    nthreads=gather_threads or _SCATTER_GATHER_THREADS)
+                native.scatter_gather(src, idx, dest, out,
+                                      nthreads=col_threads)
             elif idx is None:
                 out[dest] = src
             else:
                 out[dest] = src[idx]
             offset += n
-        out_cols[name] = out
+        return out
+
+    if fan_out > 1:
+        pool = _column_gather_pool()
+        futures = [pool.submit(_gather_column, name) for name in names[1:]]
+        out_cols = {names[0]: _gather_column(names[0])}
+        for name, future in zip(names[1:], futures):
+            out_cols[name] = future.result()
+    else:
+        out_cols = {name: _gather_column(name) for name in names}
     return pa.table(out_cols)
 
 
@@ -1021,6 +1086,17 @@ def shuffle_epoch(epoch: int,
     """
     if stats_collector is not None:
         stats_collector.epoch_start(epoch)
+    if getattr(pool, "backend", "thread") == "process":
+        reduce_refs = _shuffle_epoch_process(
+            epoch, filenames, num_reducers, pool, seed, stats_collector,
+            map_transform, reduce_transform, spill_manager, gather_threads,
+            on_bad_file)
+        for trainer_idx, batches in enumerate(
+                ops.contiguous_splits(reduce_refs, num_trainers)):
+            consume(trainer_idx, batch_consumer, trial_start,
+                    stats_collector, epoch, batches)
+            batch_consumer(trainer_idx, epoch, None)
+        return reduce_refs
     policies = fault_policies if fault_policies is not None \
         else default_fault_policies()
     map_refs = [
@@ -1063,6 +1139,37 @@ def shuffle_epoch(epoch: int,
     return reduce_refs
 
 
+def _shuffle_epoch_process(epoch, filenames, num_reducers, pool, seed,
+                           stats_collector, map_transform,
+                           reduce_transform, spill_manager, gather_threads,
+                           on_bad_file):
+    """Process-backend epoch launch: delegate to the pool's data plane
+    (procpool.process_epoch) with the workload hooks pickled once. The
+    spill-recompute lineage closure is driver-side (identical to the
+    thread path), so a corrupt spilled segment recovers the same way on
+    either backend."""
+    import pickle as _pickle
+    from ray_shuffling_data_loader_tpu import procpool
+    filenames_list = list(filenames)
+    if gather_threads is None:
+        gather_threads = derive_gather_threads(num_reducers,
+                                               pool.num_workers)
+
+    def _spill_recompute_factory(reduce_index: int):
+        return functools.partial(
+            recompute_reducer_output, filenames_list, num_reducers, seed,
+            epoch, reduce_index, map_transform, reduce_transform,
+            on_bad_file)
+
+    return procpool.process_epoch(
+        epoch, filenames_list, num_reducers, pool, seed, stats_collector,
+        _pickle.dumps(map_transform) if map_transform is not None else None,
+        _pickle.dumps(reduce_transform)
+        if reduce_transform is not None else None,
+        spill_manager, gather_threads, on_bad_file,
+        _spill_recompute_factory if spill_manager is not None else None)
+
+
 def shuffle(filenames: Sequence[str],
             batch_consumer: BatchConsumer,
             num_epochs: int,
@@ -1080,7 +1187,8 @@ def shuffle(filenames: Sequence[str],
             task_retries: int = 0,
             max_inflight_bytes: Optional[int] = None,
             spill_dir: Optional[str] = None,
-            on_bad_file: Optional[str] = None
+            on_bad_file: Optional[str] = None,
+            executor_backend: Optional[str] = None
             ) -> Union[stats_mod.TrialStats, float]:
     """Multi-epoch pipelined shuffle driver (reference: shuffle.py:79-160).
 
@@ -1144,16 +1252,39 @@ def shuffle(filenames: Sequence[str],
     rt_telemetry.set_trace_seed(seed)
     start = timeit.default_timer()
 
-    # Caching only pays when a file is mapped more than once.
-    file_cache, owns_file_cache = resolve_file_cache(
-        file_cache, num_epochs - start_epoch)
     owns_pool = pool is None
     if pool is None:
-        pool = ex.Executor(num_workers=num_workers,
-                           task_retries=task_retries)
+        # Backend selection (kwarg > RSDL_EXECUTOR_BACKEND > auto): the
+        # process pool is the multicore data plane; the thread pool stays
+        # the fallback whenever shared memory / picklable hooks are not
+        # available (procpool.resolve_backend).
+        from ray_shuffling_data_loader_tpu import procpool
+        backend = procpool.resolve_backend(
+            override=executor_backend, num_workers=num_workers,
+            transforms=(map_transform, reduce_transform))
+        if backend == "process":
+            pool = procpool.ProcessPoolExecutor(num_workers=num_workers,
+                                                task_retries=task_retries)
+        else:
+            pool = ex.Executor(num_workers=num_workers,
+                               task_retries=task_retries)
+    process_backend = getattr(pool, "backend", "thread") == "process"
+    if process_backend:
+        # The pool's shm segment arena IS the decoded-file cache in
+        # process mode (cross-epoch table segments); a driver-side table
+        # cache would just duplicate the resident set. The pool exposes
+        # `bytes_cached`, so the transient-byte budget discounts cache
+        # growth exactly like a FileTableCache.
+        file_cache, owns_file_cache = None, False
+        budget_cache = pool
+    else:
+        # Caching only pays when a file is mapped more than once.
+        file_cache, owns_file_cache = resolve_file_cache(
+            file_cache, num_epochs - start_epoch)
+        budget_cache = file_cache
     from ray_shuffling_data_loader_tpu.spill import make_budget_state
     _over_budget, spill_manager = make_budget_state(
-        file_cache, max_inflight_bytes, spill_dir)
+        budget_cache, max_inflight_bytes, spill_dir)
     # Epoch pipelining keeps up to max_concurrent_epochs epochs' reduce
     # tasks in flight on this one pool — size gather threads for that
     # total, not one epoch's worth (but no more epochs than actually run).

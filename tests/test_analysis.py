@@ -373,6 +373,33 @@ SPAN_BALANCED_OK = """
         return telemetry.span_begin("queue_wait", epoch=epoch)
 """
 
+COPY_HOT_PATH_BAD = """
+    import numpy as np
+
+    def gather(table, perm, dtype):
+        col = table.column("x")
+        arr = col.to_numpy(zero_copy_only=False)
+        combined = col.combine_chunks()
+        return arr[perm].astype(dtype)
+"""
+
+COPY_HOT_PATH_OK = """
+    import numpy as np
+
+    def gather(table, perm, dtype):
+        col = table.column("x")
+        # Blessed cached site. rsdl-lint: disable=copy-in-hot-path
+        arr = col.to_numpy(zero_copy_only=False)
+        plain = col.to_numpy()  # zero_copy_only defaults to True
+        return arr[perm].astype(dtype, copy=False)
+"""
+
+COPY_HOT_PATH_OTHER_FILE_OK = """
+    def gather(table, perm, dtype):
+        arr = table.column("x").to_numpy(zero_copy_only=False)
+        return arr[perm].astype(dtype)
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -393,7 +420,22 @@ CASES = [
     ("socket-op-no-timeout", SOCKET_TIMEOUT_BAD, SOCKET_TIMEOUT_OK, {}),
     ("span-unbalanced", SPAN_NO_END_BAD, SPAN_BALANCED_OK, {}),
     ("span-unbalanced", SPAN_NO_FINALLY_BAD, SPAN_BALANCED_OK, {}),
+    ("copy-in-hot-path", COPY_HOT_PATH_BAD, COPY_HOT_PATH_OK,
+     {"path": "pkg/shuffle.py"}),
 ]
+
+
+def test_copy_in_hot_path_scoped_to_hot_path_modules():
+    # The same copying code outside the hot-path modules is not flagged
+    # (and jax_dataset.py IS covered while torch_dataset.py is not).
+    flagged, _ = lint(COPY_HOT_PATH_OTHER_FILE_OK, path="pkg/utils.py")
+    assert "copy-in-hot-path" not in flagged
+    flagged, _ = lint(COPY_HOT_PATH_OTHER_FILE_OK,
+                      path="pkg/torch_dataset.py")
+    assert "copy-in-hot-path" not in flagged
+    flagged, _ = lint(COPY_HOT_PATH_OTHER_FILE_OK,
+                      path="pkg/jax_dataset.py")
+    assert "copy-in-hot-path" in flagged
 
 
 @pytest.mark.parametrize("rule_id,bad,good,kwargs",

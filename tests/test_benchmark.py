@@ -114,8 +114,20 @@ def test_bench_py_json_contract(tmp_path):
                 # REAL DLRM train step).
                 "cold_rows_per_sec", "vs_baseline_cached",
                 "stall_pct_under_train", "train_rows_per_sec",
-                "train_step_ms_mean", "train_final_loss"):
+                "train_step_ms_mean", "train_final_loss",
+                # Executor honesty fields (ISSUE 7): the record names the
+                # data plane that actually ran and normalizes per-core by
+                # the effective pool width, never os.cpu_count().
+                "executor_backend", "executor_workers",
+                "executor_worker_pids", "rows_per_s_per_core",
+                "worker_scaling"):
         assert key in record, key
+    assert record["executor_backend"] in ("thread", "process")
+    assert record["executor_workers"] >= 1
+    assert record["rows_per_s_per_core"] == pytest.approx(
+        record["value"] / record["executor_workers"], rel=1e-3)
+    scaling = record["worker_scaling"]
+    assert scaling["rows_per_s_by_workers"]["1"] > 0
     assert record["metric"] == "shuffle_ingest_rows_per_sec_per_chip"
     assert record["unit"] == "rows/s"
     assert record["value"] > 0 and record["vs_baseline"] > 0

@@ -75,3 +75,54 @@ def test_wait_preserves_input_order():
         refs = [pool.submit(time.sleep, 0.05 - 0.01 * i) for i in range(4)]
         done, _ = ex.wait(refs, num_returns=4)
         assert done == refs  # stable w.r.t. input order
+
+
+def test_wait_wakes_exactly_on_kth_completion():
+    """wait(num_returns=k) must return as soon as the k-th ref completes
+    — not before (2 of 3 done keeps it blocked) and without waiting for
+    the stragglers (regression test for the O(n^2) pending rebuild,
+    which also re-scanned satisfied futures on every wake)."""
+    gates = [threading.Event() for _ in range(5)]
+    with ex.Executor(num_workers=5) as pool:
+        refs = [pool.submit(gate.wait) for gate in gates]
+        result = {}
+
+        def waiter():
+            result["done"], result["not_done"] = ex.wait(refs,
+                                                         num_returns=3)
+
+        thread = threading.Thread(target=waiter, daemon=True)
+        thread.start()
+        gates[1].set()
+        gates[3].set()
+        thread.join(timeout=0.3)
+        assert thread.is_alive(), "wait returned before the 3rd completion"
+        gates[0].set()  # the k-th completion
+        thread.join(timeout=5.0)
+        assert not thread.is_alive(), "wait missed the 3rd completion"
+        assert len(result["done"]) == 3
+        assert len(result["not_done"]) == 2
+        # Stable input order in done, stragglers in not_done.
+        assert [refs.index(r) for r in result["done"]] == [0, 1, 3]
+        assert [refs.index(r) for r in result["not_done"]] == [2, 4]
+        for gate in gates:
+            gate.set()
+
+
+def test_wait_large_fanout_drops_satisfied_futures():
+    """After the fix, wait(k) over a large fan-out completes promptly
+    even when completions arrive one at a time."""
+    with ex.Executor(num_workers=8) as pool:
+        refs = [pool.submit(lambda i=i: i) for i in range(500)]
+        done, not_done = ex.wait(refs, num_returns=500)
+        assert len(done) == 500 and not not_done
+        assert ex.get(done) == sorted(ex.get(done))
+
+
+def test_thread_backend_reports_pool_shape():
+    assert ex.Executor(num_workers=3).backend == "thread"
+    with ex.Executor(num_workers=3) as pool:
+        import os
+        assert pool.worker_pids() == [os.getpid()]
+        info = ex.last_worker_pool()
+        assert info["backend"] == "thread" and info["workers"] == 3

@@ -513,3 +513,148 @@ def test_cast_at_map_preserves_values_end_to_end(tmp_path):
     f_raw, y_raw = collect(False, "cast-off")
     np.testing.assert_array_equal(f_cast, f_raw)
     np.testing.assert_array_equal(y_cast, y_raw)
+
+
+# ---------------------------------------------------------------------------
+# derive_gather_threads edge cases (ISSUE 7 satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_derive_gather_threads_host_share_exceeds_cores(monkeypatch):
+    import os
+    monkeypatch.setattr(os, "cpu_count", lambda: 4)
+    # host_share > cores: integer division hits 0 cores; the 1-thread
+    # floor must hold instead of returning 0.
+    assert sh.derive_gather_threads(2, 8, host_share=16) == 1
+
+
+def test_derive_gather_threads_concurrent_exceeds_pool(monkeypatch):
+    import os
+    monkeypatch.setattr(os, "cpu_count", lambda: 32)
+    # concurrent_reduces > pool_workers: only pool_workers reduce tasks
+    # can actually run at once, so threads divide by the pool width.
+    assert sh.derive_gather_threads(100, 4) == 8
+    assert sh.derive_gather_threads(4, 100) == 8
+
+
+def test_derive_gather_threads_one_core_floor(monkeypatch):
+    import os
+    monkeypatch.setattr(os, "cpu_count", lambda: 1)
+    assert sh.derive_gather_threads(8, 8) == 1
+    monkeypatch.setattr(os, "cpu_count", lambda: None)
+    assert sh.derive_gather_threads(1, 1) == 1
+
+
+def test_derive_gather_threads_cap_sixteen(monkeypatch):
+    import os
+    monkeypatch.setattr(os, "cpu_count", lambda: 256)
+    assert sh.derive_gather_threads(1, 1) == 16
+
+
+# ---------------------------------------------------------------------------
+# scatter_gather fallback matrix: every arm bit-identical to NumPy
+# ---------------------------------------------------------------------------
+
+
+def _sg_numpy(src, idx, dest, out):
+    if idx is None:
+        out[dest] = src
+    else:
+        out[dest] = src[idx]
+    return out
+
+
+@pytest.mark.skipif(not native.available(), reason="native library absent")
+def test_scatter_gather_noncontiguous_source_falls_back():
+    rng = np.random.default_rng(0)
+    base = rng.integers(0, 1000, 2000).astype(np.int64)
+    src = base[::2]  # stride-2 view: NOT c-contiguous
+    assert not src.flags.c_contiguous
+    n = len(src)
+    idx = rng.permutation(n).astype(np.int32)
+    dest = rng.permutation(n).astype(np.int32)
+    expected = _sg_numpy(src, idx, dest, np.empty(n, dtype=np.int64))
+    # The fused-reduce guard routes non-contiguous sources to the numpy
+    # arm; the native kernel on a contiguous copy must agree exactly.
+    out = np.empty(n, dtype=np.int64)
+    native.scatter_gather(np.ascontiguousarray(src), idx, dest, out)
+    assert np.array_equal(out, expected)
+
+
+@pytest.mark.skipif(not native.available(), reason="native library absent")
+def test_scatter_gather_itemsize16_unsupported_numpy_matches():
+    rng = np.random.default_rng(1)
+    src = (rng.random(512) + 1j * rng.random(512)).astype(np.complex128)
+    assert src.dtype.itemsize == 16
+    n = len(src)
+    idx = rng.permutation(n).astype(np.int32)
+    dest = rng.permutation(n).astype(np.int32)
+    out = np.empty(n, dtype=np.complex128)
+    with pytest.raises(ValueError):
+        native.scatter_gather(src, idx, dest, out)
+    expected = _sg_numpy(src, idx, dest, np.empty(n, dtype=np.complex128))
+    # The numpy fallback arm is the production path for 16-byte elements.
+    assert np.array_equal(
+        _sg_numpy(src, idx, dest, np.empty(n, dtype=np.complex128)),
+        expected)
+
+
+@pytest.mark.skipif(not native.available(), reason="native library absent")
+def test_scatter_gather_int64_index_path_matches_native_int32():
+    # Above 2**31 rows _fused_reduce escalates indices to int64 and the
+    # native kernel (int32-only) is bypassed; the two arms must agree on
+    # identical data.
+    rng = np.random.default_rng(2)
+    for dtype in (np.uint8, np.int16, np.float32, np.float64):
+        src = rng.integers(0, 100, 4096).astype(dtype)
+        n = len(src)
+        idx32 = rng.permutation(n).astype(np.int32)
+        dest32 = rng.permutation(n).astype(np.int32)
+        native_out = np.empty(n, dtype=dtype)
+        native.scatter_gather(src, idx32, dest32, native_out, nthreads=2)
+        numpy_out = _sg_numpy(src, idx32.astype(np.int64),
+                              dest32.astype(np.int64),
+                              np.empty(n, dtype=dtype))
+        assert np.array_equal(native_out, numpy_out), dtype
+        # idx=None arm (source already in reducer order).
+        native_out2 = np.empty(n, dtype=dtype)
+        native.scatter_gather(src, None, dest32, native_out2)
+        assert np.array_equal(
+            native_out2, _sg_numpy(src, None, dest32.astype(np.int64),
+                                   np.empty(n, dtype=dtype))), dtype
+
+
+def test_fused_reduce_column_fanout_bit_identical():
+    # The per-column thread fan-out must not change a single bit vs the
+    # sequential gather (columns are independent).
+    rng = np.random.default_rng(3)
+    n = 1 << 17  # above the fan-out floor
+    cols = {f"c{i}": rng.integers(0, 1000, n).astype(np.int64)
+            for i in range(4)}
+    sources = [(cols, None, n)]
+    wide = sh._fused_reduce(0, seed=9, epoch=0, sources=list(sources),
+                            column_names=list(cols), gather_threads=4)
+    narrow = sh._fused_reduce(0, seed=9, epoch=0, sources=list(sources),
+                              column_names=list(cols), gather_threads=1)
+    assert wide.equals(narrow)
+
+
+def test_plan_partition_native_and_numpy_bit_identical(monkeypatch):
+    parts_native = sh.plan_map_partition(20_000, 7, seed=5, epoch=2,
+                                         file_index=3)
+    monkeypatch.setattr(native, "available", lambda: False)
+    parts_numpy = sh.plan_map_partition(20_000, 7, seed=5, epoch=2,
+                                        file_index=3)
+    assert len(parts_native) == len(parts_numpy) == 7
+    for a, b in zip(parts_native, parts_numpy):
+        assert np.array_equal(a, b)
+
+
+def test_partition_plan_policy_philox_legacy(monkeypatch):
+    from ray_shuffling_data_loader_tpu.ops import partition as P
+    monkeypatch.setenv("RSDL_SHUFFLE_PARTITION_PLAN", "philox")
+    parts = sh.plan_map_partition(5000, 4, seed=1, epoch=0, file_index=0)
+    rng = P.map_rng(1, 0, 0)
+    expected = P.partition_indices(P.assign_reducers(5000, 4, rng), 4)
+    for a, b in zip(parts, expected):
+        assert np.array_equal(a, b)

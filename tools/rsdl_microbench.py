@@ -1,0 +1,197 @@
+#!/usr/bin/env python
+"""Stage microbenchmarks for the shuffle hot path.
+
+The end-to-end bench (bench.py) answers "how fast is the pipeline"; this
+tool answers "which KERNEL regressed" — each stage of the map/reduce hot
+path is timed in isolation over synthetic data, so a per-stage drift
+surfaces before the next full bench round (the r03 -> r05 ingest
+regression hid for two PRs because only the end-to-end number was
+watched).
+
+Stages:
+
+- ``parquet_decode``   — pq.read_table of a page-cache-warm file
+- ``partition_fused``  — the one-kernel hash partition plan
+                         (ops.plan_partition_flat)
+- ``partition_philox`` — the legacy two-stage Philox draw + counting sort
+- ``fused_gather``     — shuffle._fused_reduce over one source
+- ``ipc_handoff``      — Arrow IPC segment write + zero-copy mmap open
+                         (the process backend's shm handoff)
+- ``telemetry_record`` — per-event flight-recorder cost (enabled path)
+
+Output: a JSON record on stdout whose ``stages`` block mirrors the bench
+record's ``stage_latency_ms`` schema (``p50_ms``/``p95_ms``/``p99_ms``
+per stage) plus throughput fields, and a human table on stderr.
+
+``--check`` is the format.sh informational mode: small row count, always
+rc 0 — the gate surfaces the numbers without failing the build (the hard
+regression gate stays ``bench.py --baseline`` / ``tools/rsdl_bench_diff``
+at measurement time).
+"""
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import tempfile
+import timeit
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def _time_stage(fn, repeats):
+    """Per-repeat wall seconds (first call primed separately, so one-time
+    costs — import, page-cache fill, pool spin-up — don't pollute p50)."""
+    fn()
+    samples = []
+    for _ in range(repeats):
+        start = timeit.default_timer()
+        fn()
+        samples.append(timeit.default_timer() - start)
+    return samples
+
+
+def _stage_record(samples, rows):
+    ordered = sorted(samples)
+
+    def pct(p):
+        return ordered[min(len(ordered) - 1, int(p / 100 * len(ordered)))]
+
+    p50 = statistics.median(ordered)
+    return {
+        "p50_ms": round(p50 * 1e3, 4),
+        "p95_ms": round(pct(95) * 1e3, 4),
+        "p99_ms": round(pct(99) * 1e3, 4),
+        "rows_per_s": round(rows / p50, 1) if p50 > 0 else None,
+        "ns_per_row": round(1e9 * p50 / rows, 3) if rows else None,
+    }
+
+
+def run(rows: int, repeats: int, num_reducers: int) -> dict:
+    import numpy as np
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    import importlib
+    sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
+    from ray_shuffling_data_loader_tpu import procpool
+    from ray_shuffling_data_loader_tpu.ops import partition as ops_p
+    from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_tel
+
+    rng = np.random.default_rng(0)
+    table = pa.table({
+        "dense": rng.random(rows),
+        "sparse": rng.integers(0, 1 << 20, rows).astype(np.int64),
+        "label": rng.integers(0, 2, rows).astype(np.int32),
+    })
+    stages = {}
+
+    with tempfile.TemporaryDirectory(prefix="rsdl-microbench-") as tmp:
+        parquet_path = os.path.join(tmp, "part.parquet")
+        pq.write_table(table, parquet_path)
+
+        stages["parquet_decode"] = _stage_record(
+            _time_stage(lambda: pq.read_table(parquet_path), repeats), rows)
+
+        stages["partition_fused"] = _stage_record(
+            _time_stage(lambda: ops_p.plan_partition_flat(
+                rows, num_reducers, seed=0, epoch=0, file_index=0,
+                nthreads=sh._SCATTER_GATHER_THREADS), repeats), rows)
+
+        def philox():
+            gen = ops_p.map_rng(0, 0, 0)
+            assignments = ops_p.assign_reducers(rows, num_reducers, gen)
+            ops_p.partition_indices(assignments, num_reducers)
+
+        stages["partition_philox"] = _stage_record(
+            _time_stage(philox, repeats), rows)
+
+        cols = {name: table.column(name).chunk(0).to_numpy()
+                for name in table.column_names}
+        sources = [(cols, None, rows)]
+        stages["fused_gather"] = _stage_record(
+            _time_stage(lambda: sh._fused_reduce(
+                0, seed=0, epoch=0, sources=list(sources),
+                column_names=table.column_names,
+                gather_threads=sh._SCATTER_GATHER_THREADS), repeats), rows)
+
+        seg_dir = procpool.shm_base_dir()
+        seg_path = os.path.join(
+            tempfile.mkdtemp(prefix="rsdl-microbench-", dir=seg_dir),
+            "seg.arrow")
+
+        def handoff():
+            procpool.write_table_segment(table, seg_path)
+            procpool.open_table_segment(seg_path)
+
+        try:
+            stages["ipc_handoff"] = _stage_record(
+                _time_stage(handoff, repeats), rows)
+        finally:
+            try:
+                os.unlink(seg_path)
+                os.rmdir(os.path.dirname(seg_path))
+            except OSError:
+                pass
+
+    per_event_s = rt_tel.measure_record_overhead()
+    stages["telemetry_record"] = {
+        "p50_ms": round(per_event_s * 1e3, 6),
+        "p95_ms": None,
+        "p99_ms": None,
+        "rows_per_s": None,
+        "ns_per_event": round(per_event_s * 1e9, 1),
+    }
+
+    return {
+        "metric": "microbench_stage_latency",
+        "rows": rows,
+        "repeats": repeats,
+        "num_reducers": num_reducers,
+        "host_cpus": os.cpu_count(),
+        "stages": stages,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--rows", type=int, default=1_000_000)
+    parser.add_argument("--repeats", type=int, default=5)
+    parser.add_argument("--reducers", type=int, default=16)
+    parser.add_argument("--json", metavar="PATH",
+                        help="also write the record to PATH")
+    parser.add_argument("--check", action="store_true",
+                        help="format.sh informational mode: quick sizes, "
+                             "always exit 0")
+    args = parser.parse_args(argv)
+    if args.check:
+        args.rows = min(args.rows, 200_000)
+        args.repeats = min(args.repeats, 3)
+    try:
+        record = run(args.rows, args.repeats, args.reducers)
+    except Exception as e:  # noqa: BLE001 - informational tool
+        print(f"rsdl-microbench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return 0 if args.check else 1
+    for name, stage in record["stages"].items():
+        rate = stage.get("rows_per_s")
+        rate_txt = f"{rate:>14,.0f} rows/s" if rate else " " * 21
+        extra = (f"  {stage['ns_per_event']:.0f} ns/event"
+                 if "ns_per_event" in stage else
+                 f"  {stage['ns_per_row']:.1f} ns/row"
+                 if stage.get("ns_per_row") is not None else "")
+        print(f"# {name:<18} p50 {stage['p50_ms']:>10.3f} ms"
+              f"{rate_txt}{extra}", file=sys.stderr)
+    payload = json.dumps(record, indent=2)
+    print(payload)
+    if args.json:
+        with open(args.json, "w") as f:
+            f.write(payload + "\n")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
