@@ -1078,6 +1078,7 @@ def main() -> None:
 
     from ray_shuffling_data_loader_tpu import executor as rsdl_ex
     from ray_shuffling_data_loader_tpu import stats as rsdl_stats
+    from ray_shuffling_data_loader_tpu.runtime import health as rt_health
     from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
     from ray_shuffling_data_loader_tpu.runtime import profiler as rt_profiler
     from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_tel
@@ -1085,10 +1086,13 @@ def main() -> None:
     from ray_shuffling_data_loader_tpu.utils.tracing import maybe_profile
 
     # Telemetry spine: the whole invocation is flight-recorded (SIGUSR1
-    # dumps the ring + named-thread stacks at any point), and the
-    # exposition exporter comes up when RSDL_METRICS_FILE /
-    # RSDL_METRICS_PORT are set so `tools/rsdl_top.py` can watch live.
+    # dumps the ring + named-thread stacks at any point; SIGUSR2 captures
+    # a full incident capsule on demand), and the exposition exporter
+    # comes up when RSDL_METRICS_FILE / RSDL_METRICS_PORT are set so
+    # `tools/rsdl_top.py` can watch live.
     rt_tel.install_signal_dump()
+    rt_health.install_incident_signal()
+    rt_metrics.maybe_start_shard_writer()
     if (rt_policy.resolve("metrics", "metrics_file")
             or rt_policy.resolve("metrics", "metrics_port")):
         rt_metrics.start_exporter()
@@ -1134,11 +1138,40 @@ def main() -> None:
             qname=qname, max_inflight_bytes=max_inflight_bytes,
             spill_dir=spill_dir)
 
+    # Ops plane (runtime/health.py): SLO detectors armed fresh per timed
+    # phase — the phases have deliberately different rate regimes, so a
+    # droop baseline must never span a phase boundary. The ingest phases
+    # run a near-zero-work consumer whose stall is ~100% of wall BY
+    # CONSTRUCTION, so stall_breach arms only under train (the phase the
+    # <=10% contract governs). A detector fire auto-captures an incident
+    # capsule, lands in the record's `health` section, and — with
+    # --baseline — fails the invocation like any other regression.
+    health_by_phase = {}
+
+    def _armed_phase(name, fn, with_stall=False):
+        detectors = [d for d in ("throughput_droop", "stall_breach",
+                                 "ledger_creep", "queue_saturation",
+                                 "lease_churn", "straggler_drift")
+                     if with_stall or d != "stall_breach"]
+        rt_health.arm(component="bench", detectors=detectors)
+        try:
+            return _phase(name, fn)
+        finally:
+            finished = rt_health.disarm()
+            if finished is not None:
+                finished.wait_captures(timeout_s=15.0)
+                health_by_phase[name] = finished.summary()
+                if finished.total_fires:
+                    print(f"# health: {finished.total_fires} detector "
+                          f"fire(s) during {name} "
+                          f"({sorted(d for d, s in finished.summary()['detectors'].items() if s['fires'])})",
+                          file=sys.stderr)
+
     # Host-side sampling profiler next to the JAX device profiler: one
     # window, two views (RSDL_PROFILER=1 / RSDL_PROFILE_FOLDED=<path>).
     with maybe_profile(), rt_profiler.maybe_sample() as sampling_prof:
         if "cached" in phases:
-            cached = _phase("cached", lambda: _ingest(
+            cached = _armed_phase("cached", lambda: _ingest(
                 "bench-cached", cold=False, epochs=num_epochs))
             if cached is not None:
                 print(f"# cached: {cached['rows_per_s']:,.0f} rows/s, stall "
@@ -1149,7 +1182,7 @@ def main() -> None:
             # in-window decode+IPC-write doesn't dominate the average.
             cold_epochs = int(os.environ.get("RSDL_BENCH_COLD_EPOCHS",
                                              min(6, num_epochs)))
-            cold = _phase("cold", lambda: _ingest(
+            cold = _armed_phase("cold", lambda: _ingest(
                 "bench-cold", cold=True, epochs=cold_epochs))
             if cold is not None:
                 print(f"# cold: {cold['rows_per_s']:,.0f} rows/s, stall "
@@ -1182,17 +1215,26 @@ def main() -> None:
                 "RSDL_BENCH_RUNS",
                 "1" if os.environ.get("RSDL_BENCH_CPU") else "3")))
             train_runs = []
-            for run_i in range(n_runs):
-                r = _phase(f"train[{run_i}]", lambda run_i=run_i: run_train(
-                    jax, filenames, num_epochs=train_epochs,
-                    batch_size=train_batch,
-                    num_reducers=num_reducers,
-                    prefetch_size=prefetch_size,
-                    device_rebatch=device_rebatch,
-                    model_size=model_size, microbatch=train_mb,
-                    qname=f"bench-train-r{run_i}"))
-                if r is not None:
-                    train_runs.append(r)
+
+            def _run_train_phase():
+                for run_i in range(n_runs):
+                    r = _phase(f"train[{run_i}]",
+                               lambda run_i=run_i: run_train(
+                                   jax, filenames, num_epochs=train_epochs,
+                                   batch_size=train_batch,
+                                   num_reducers=num_reducers,
+                                   prefetch_size=prefetch_size,
+                                   device_rebatch=device_rebatch,
+                                   model_size=model_size,
+                                   microbatch=train_mb,
+                                   qname=f"bench-train-r{run_i}"))
+                    if r is not None:
+                        train_runs.append(r)
+                return train_runs or None
+
+            # One armed window across the median-of-N runs: the stall
+            # contract phase judges stall_breach too.
+            _armed_phase("train", _run_train_phase, with_stall=True)
             train_agg = None
             if train_runs:
                 train_agg = _aggregate_train_runs(train_runs)
@@ -1391,6 +1433,18 @@ def main() -> None:
     # events (runtime/trace.py): which stages/tasks the epochs actually
     # waited on, and what a 2x speedup of each would buy.
     record.update(rt_trace.bench_fields(rt_tel.recorder().events()))
+    # Ops-plane evidence (runtime/health.py): per-phase detector
+    # episodes. `fires` > 0 means an SLO detector saw a sustained breach
+    # DURING a timed phase — with --baseline this fails the invocation
+    # (below); the auto-captured capsules name the evidence either way.
+    health_fires = sum(s.get("fires", 0) for s in health_by_phase.values())
+    record["health"] = {
+        "armed": bool(health_by_phase),
+        "fires": health_fires,
+        "by_phase": health_by_phase,
+        "capsules": [c for s in health_by_phase.values()
+                     for c in s.get("capsules", [])],
+    }
     if sampling_prof is not None:
         record["profile"] = sampling_prof.summary()
     if chaos_rate is not None or any(fs_delta.values()):
@@ -1506,7 +1560,19 @@ def main() -> None:
                   f"{len(regressions)} metric(s) regressed",
                   file=sys.stderr)
             sys.exit(1)
-        print(f"# bench-diff OK vs {baseline_path}", file=sys.stderr)
+        # The gate extends to live health: a sustained SLO breach during
+        # a timed phase is a regression even when the aggregate numbers
+        # survive (a droop the window averaged away, a leak still
+        # climbing at exit). The capsules in record["health"] are the
+        # forensic record of exactly what fired.
+        if health_fires:
+            print(f"# health gate FAILED vs {baseline_path}: "
+                  f"{health_fires} detector fire(s) during timed phases "
+                  f"(capsules: {record['health']['capsules']})",
+                  file=sys.stderr)
+            sys.exit(1)
+        print(f"# bench-diff OK vs {baseline_path} (health: 0 fires)",
+              file=sys.stderr)
 
 
 if __name__ == "__main__":

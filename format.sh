@@ -59,4 +59,11 @@ if ls BENCH_r*.json >/dev/null 2>&1; then
     python tools/rsdl_bench_diff.py --check .
 fi
 
+# Run-report schema smoke (tools/rsdl_report.py, stdlib-only): validates
+# that the committed bench records (and any history/capsule artifacts
+# handed to it) still parse against the report's schema without writing
+# HTML. Informational (rc 0), same contract as the checks above.
+echo "-- rsdl-report (check mode)"
+python tools/rsdl_report.py --check
+
 echo "OK"

@@ -98,6 +98,11 @@ class Config:
     stdlib_random_names: Tuple[str, ...] = (
         "random", "randint", "randrange", "choice", "choices", "shuffle",
         "sample", "uniform", "gauss", "normalvariate", "seed")
+    # fnmatch patterns of files whose literal rsdl_* metric names must
+    # come from runtime/metric_names.py (library code; tests may mint
+    # throwaway test_* names, which the rule ignores by prefix anyway).
+    metric_catalog_globs: Tuple[str, ...] = (
+        "ray_shuffling_data_loader_tpu/*", "bench.py")
 
     @classmethod
     def from_dict(cls, data: dict) -> "Config":
@@ -144,7 +149,7 @@ def all_rules() -> Dict[str, Rule]:
     """The registry, with the built-in rule modules imported."""
     from ray_shuffling_data_loader_tpu.analysis import (  # noqa: F401
         rules_arrow, rules_executor, rules_hygiene, rules_jax, rules_lock,
-        rules_perf, rules_runtime, rules_telemetry)
+        rules_metrics, rules_perf, rules_runtime, rules_telemetry)
     return dict(_REGISTRY)
 
 

@@ -174,6 +174,13 @@ class Executor:
         self._tasks_submitted = rt_metrics.counter(
             "rsdl_executor_tasks_total", "tasks submitted by pool name",
             pool=thread_name_prefix)
+        # The thread backend's "worker process" is this process: publish
+        # it under the same per-pid gauge the process pool uses so
+        # rsdl_top's per-process view reads identically across backends.
+        rt_metrics.gauge("rsdl_executor_worker_up",
+                         "1 while the pid is a live pool worker",
+                         pool=thread_name_prefix,
+                         pid=str(os.getpid())).set(1)
         if retry_policy is None and task_retries:
             from ray_shuffling_data_loader_tpu.runtime import retry as rt
             retry_policy = rt.RetryPolicy.for_component(

@@ -28,6 +28,7 @@ import time
 from typing import Any, List, Optional
 
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
 from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
@@ -182,6 +183,7 @@ class MultiQueue:
             self._async_pool = peer._async_pool
             self._inflight_async = peer._inflight_async
             self._inflight_lock = peer._inflight_lock
+            self._depth_gauges = peer._depth_gauges
             return
         if num_queues < 1:
             raise ValueError(f"num_queues must be >= 1, got {num_queues}")
@@ -196,6 +198,7 @@ class MultiQueue:
             max_workers=2, thread_name_prefix="rsdl-queue-async")
         self._inflight_async: set = set()
         self._inflight_lock = threading.Lock()
+        self._depth_gauges: dict = {}
         if name is not None:
             with _REGISTRY_LOCK:
                 if name in _REGISTRY:
@@ -220,6 +223,18 @@ class MultiQueue:
         if self._shutdown_event.is_set():
             raise RuntimeError(f"MultiQueue {self._name!r} is shut down")
 
+    def _note_depth(self, queue_index: int) -> None:
+        """Refresh the per-queue depth gauge (the health plane's
+        ``queue_saturation`` detector judges this series). Callers gate
+        on a truthy ``stamp()`` so the hard-off telemetry path pays
+        nothing extra."""
+        gauge = self._depth_gauges.get(queue_index)
+        if gauge is None:
+            gauge = self._depth_gauges[queue_index] = rt_metrics.gauge(
+                "rsdl_queue_depth", "items resident per queue",
+                queue=str(queue_index))
+        gauge.set(self._queues[queue_index].qsize())
+
     # -- puts ---------------------------------------------------------------
 
     def put(self, queue_index: int, item: Any, block: bool = True,
@@ -241,6 +256,8 @@ class MultiQueue:
         # consumer (or a bounded queue) is the slow side.
         rt_telemetry.record("queue_put", task=queue_index,
                             dur_s=rt_telemetry.stamp() - start)
+        if start:  # stamp() is 0.0 exactly when telemetry is hard-off
+            self._note_depth(queue_index)
 
     def put_nowait(self, queue_index: int, item: Any) -> None:
         self.put(queue_index, item, block=False)
@@ -260,6 +277,8 @@ class MultiQueue:
             self._queues[queue_index].put_batch_atomic(items)
         except Full as e:
             raise Full(f"queue {queue_index}: {e}")
+        if rt_telemetry.stamp():
+            self._note_depth(queue_index)
 
     def _submit_async(self, fn, *args) -> cf.Future:
         fut = self._async_pool.submit(fn, *args)
@@ -290,6 +309,8 @@ class MultiQueue:
             raise Empty(f"queue {queue_index} is empty")
         rt_telemetry.record("queue_get", task=queue_index,
                             dur_s=rt_telemetry.stamp() - start)
+        if start:
+            self._note_depth(queue_index)
         return item
 
     def get_nowait(self, queue_index: int) -> Any:
@@ -300,9 +321,12 @@ class MultiQueue:
         (all-or-nothing, atomic under concurrent consumers,
         reference: multiqueue.py:270-283,383-390)."""
         try:
-            return self._queues[queue_index].get_batch_atomic(num_items)
+            items = self._queues[queue_index].get_batch_atomic(num_items)
         except Empty as e:
             raise Empty(f"queue {queue_index}: {e}")
+        if rt_telemetry.stamp():
+            self._note_depth(queue_index)
+        return items
 
     def get_async(self, queue_index: int) -> cf.Future:
         """Async blocking get; resolves with the item."""

@@ -1272,6 +1272,13 @@ def _serve_main(argv: List[str]) -> int:
     except (ValueError, OSError):  # pragma: no cover - non-main thread
         pass
 
+    # Ops-plane federation (both inherited through the child env, like
+    # RSDL_TRACE_DIR): the server's registry joins the merged exposition
+    # via its per-pid shard, and an incident capture's SIGUSR1 gets a
+    # live flight-recorder dump instead of waiting for process exit.
+    rt_telemetry.install_signal_dump()
+    rt_metrics.maybe_start_shard_writer()
+
     server, shuffle_result, queue = serve_pipeline(config)
     print(f"READY {server.address[1]}", flush=True)
     try:

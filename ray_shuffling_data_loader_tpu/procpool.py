@@ -408,6 +408,17 @@ def _worker_main(conn, worker_index: int) -> None:
     # The worker owns host CPU work only; it must never initialize (or
     # wait on) an accelerator the driver owns.
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # Ops-plane federation (inherited through the spawn env like
+    # RSDL_CHAOS_SPEC): the worker writes its per-pid metrics shard
+    # under RSDL_TELEMETRY_DIR so the driver's merged exposition counts
+    # the processes doing the work, and answers the incident capture's
+    # SIGUSR1 with a flight-recorder dump into RSDL_TRACE_DIR.
+    rt_telemetry.install_signal_dump()
+    rt_metrics.maybe_start_shard_writer()
+    tasks_done = rt_metrics.counter(
+        "rsdl_worker_tasks_total",
+        "tasks completed inside pool worker processes",
+        worker=str(worker_index))
     # Service loop, not a retry: exits on pipe EOF (driver gone) or the
     # explicit shutdown sentinel. rsdl-lint: disable=unbounded-retry
     while True:
@@ -421,6 +432,7 @@ def _worker_main(conn, worker_index: int) -> None:
         try:
             result = _TASK_HANDLERS[kind](payload)
             reply = (task_id, True, result)
+            tasks_done.inc()
         except (KeyboardInterrupt, SystemExit):
             raise
         except BaseException as e:  # noqa: BLE001 - shipped to the driver
@@ -572,6 +584,7 @@ class ProcessPoolExecutor:
             t.start()
         ex.note_worker_pool("process", self._num_workers,
                             self.worker_pids())
+        self._publish_worker_pids()
 
     # -- Executor contract ---------------------------------------------
 
@@ -582,6 +595,15 @@ class ProcessPoolExecutor:
     def worker_pids(self) -> List[int]:
         return [w.proc.pid for w in self._workers
                 if w.proc is not None and w.proc.pid is not None]
+
+    def _publish_worker_pids(self) -> None:
+        """Per-pid pool membership for the ops plane: rsdl_top's
+        per-process view marks these pids as pool workers, and the
+        incident capture signals them for trace dumps."""
+        for pid in self.worker_pids():
+            rt_metrics.gauge("rsdl_executor_worker_up",
+                             "1 while the pid is a live pool worker",
+                             pool=self._name, pid=str(pid)).set(1)
 
     def submit(self, fn: Callable, *args, **kwargs) -> ProcTaskRef:
         blob = pickle.dumps((fn, args, kwargs))
@@ -806,11 +828,17 @@ class ProcessPoolExecutor:
             worker.conn.close()
         except OSError:
             pass
+        dead_pid = worker.proc.pid if worker.proc is not None else None
         replacement = self._spawn_worker(index)
         replacement.restarts = worker.restarts
         self._workers[index] = replacement
         ex.note_worker_pool("process", self._num_workers,
                             self.worker_pids())
+        if dead_pid is not None:
+            rt_metrics.gauge("rsdl_executor_worker_up",
+                             "1 while the pid is a live pool worker",
+                             pool=self._name, pid=str(dead_pid)).set(0)
+        self._publish_worker_pids()
         return True
 
     def _dispatch_loop(self, index: int) -> None:

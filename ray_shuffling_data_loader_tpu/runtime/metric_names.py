@@ -1,0 +1,74 @@
+"""THE metric-name catalog: every ``rsdl_*`` registry name, in one place.
+
+Dashboards, the run report (tools/rsdl_report.py), rsdl_top, the history
+ring and the health detectors all address metrics BY NAME across process
+and repo boundaries — a renamed or ad-hoc metric silently breaks every
+one of them without failing a single test. This module pins the
+vocabulary: every literal name passed to ``metrics.counter`` / ``gauge``
+/ ``histogram`` / ``get`` in library code must appear here (the
+``unregistered-metric`` rsdl-lint rule enforces it mechanically), so a
+new metric is a reviewed one-line catalog change, not drift.
+
+Keys map name -> (kind, label keys) — documentation the exposition
+already carries at runtime, kept here for humans and the lint rule.
+Stdlib-only, import-free (loadable by tools without the package).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+#: name -> (kind, labels). Histogram names implicitly expose their
+#: ``_bucket`` / ``_sum`` / ``_count`` series in the text format.
+METRIC_NAMES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
+    # -- telemetry spine (runtime/telemetry.py) --
+    "rsdl_events_total": ("counter", ("kind",)),
+    "rsdl_stage_seconds": ("histogram", ("stage",)),
+    "rsdl_batch_wait_seconds": ("histogram", ()),
+    "rsdl_trace_cp_seconds": ("gauge", ("stage",)),
+    "rsdl_trace_straggler_task": ("gauge", ("stage",)),
+    "rsdl_trace_straggler_seconds": ("gauge", ("stage",)),
+    # -- watchdog / stats (stats.py) --
+    "rsdl_watchdog_events_total": ("counter", ()),
+    "rsdl_watchdog_escalations_total": ("counter", ()),
+    "rsdl_watchdog_fallbacks_total": ("counter", ()),
+    "rsdl_watchdog_stalls_total": ("counter", ("name",)),
+    # -- fault injection / recovery (stats.py) --
+    "rsdl_faults_injected_total": ("counter", ()),
+    "rsdl_faults_injected_by_site_total": ("counter", ("site",)),
+    "rsdl_fault_retries_total": ("counter", ()),
+    "rsdl_fault_recomputes_total": ("counter", ()),
+    "rsdl_fault_quarantines_total": ("counter", ()),
+    "rsdl_fault_exhausted_total": ("counter", ()),
+    "rsdl_fault_recovery_seconds": ("histogram", ()),
+    "rsdl_fault_recovery_max_seconds": ("gauge", ()),
+    # -- executor data plane (executor.py / procpool.py) --
+    "rsdl_executor_workers": ("gauge", ("pool",)),
+    "rsdl_executor_tasks_total": ("counter", ("pool",)),
+    "rsdl_executor_worker_up": ("gauge", ("pool", "pid")),
+    "rsdl_pool_worker_restarts_total": ("counter", ("pool",)),
+    "rsdl_worker_tasks_total": ("counter", ("worker",)),
+    # -- queue service (multiqueue.py / multiqueue_service.py) --
+    "rsdl_queue_depth": ("gauge", ("queue",)),
+    "rsdl_queue_frames_replayed_total": ("counter", ()),
+    "rsdl_queue_frames_nacked_total": ("counter", ()),
+    "rsdl_queue_frames_corrupt_total": ("counter", ()),
+    "rsdl_queue_client_reconnects_total": ("counter", ()),
+    "rsdl_queue_lease_expiries_total": ("counter", ()),
+    "rsdl_queue_consumers_alive": ("gauge", ()),
+    "rsdl_queue_server_restarts_total": ("counter", ()),
+    # -- spill tier (spill.py) --
+    "rsdl_spills_total": ("counter", ()),
+    "rsdl_spilled_bytes_total": ("counter", ()),
+    # -- ops plane: history / health / incidents (runtime/{history,health}) --
+    "rsdl_process_rss_bytes": ("gauge", ()),
+    "rsdl_ledger_bytes_in_use": ("gauge", ()),
+    "rsdl_health_state": ("gauge", ("detector",)),
+    "rsdl_health_breaches_total": ("counter", ("detector",)),
+    "rsdl_incident_capsules_total": ("counter", ()),
+    # -- federation (runtime/metrics.py merged view) --
+    "rsdl_federated_processes": ("gauge", ()),
+}
+
+#: The lint rule's membership set.
+NAMES = frozenset(METRIC_NAMES)

@@ -39,8 +39,10 @@ from typing import Dict, Iterable, List, Optional, Tuple
 __all__ = [
     "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
     "counter", "gauge", "histogram", "get", "render", "parse_exposition",
-    "write_file", "start_http_server", "start_exporter",
-    "DEFAULT_LATENCY_BUCKETS",
+    "parse_exposition_typed", "write_file", "start_http_server",
+    "start_exporter", "DEFAULT_LATENCY_BUCKETS",
+    "telemetry_dir", "write_shard", "read_shards", "merge_series",
+    "federated_series", "render_federated", "maybe_start_shard_writer",
 ]
 
 #: Exponential-ish latency bucket upper bounds in SECONDS (``+Inf`` is
@@ -367,6 +369,22 @@ def render() -> str:
 # ---------------------------------------------------------------------------
 
 
+def parse_exposition_typed(
+        text: str) -> "tuple[Dict[str, Dict[Labels, float]], Dict[str, str]]":
+    """:func:`parse_exposition` plus the ``# TYPE`` metadata: returns
+    ``(samples, types)`` where ``types`` maps family name -> kind. The
+    federation merge needs the kinds to re-render a merged exposition
+    that itself round-trips."""
+    types: Dict[str, str] = {}
+    for raw in text.splitlines():
+        line = raw.strip()
+        if line.startswith("# TYPE "):
+            parts = line.split()
+            if len(parts) == 4:
+                types[parts[2]] = parts[3]
+    return parse_exposition(text), types
+
+
 def parse_exposition(text: str) -> Dict[str, Dict[Labels, float]]:
     """Parse Prometheus text format into ``{name: {labels: value}}``.
 
@@ -427,17 +445,214 @@ def _parse_labels(text: str) -> Labels:
 
 
 # ---------------------------------------------------------------------------
+# Multi-process federation: per-pid exposition shards + merge reader
+# ---------------------------------------------------------------------------
+#
+# Since the data plane moved into spawn-mode pool workers (procpool.py),
+# most map/reduce samples live in OTHER processes' registries — a
+# driver-only exposition under-counts exactly the processes doing the
+# work. The federation contract mirrors RSDL_TRACE_DIR: every process
+# whose environment carries RSDL_TELEMETRY_DIR writes its registry as a
+# per-pid shard file there (periodically + at exit), and readers merge
+# the shards into cluster-wide totals. Counters and histogram series sum
+# exactly; gauges also SUM in the merged view (pool widths, queue depths
+# and ledger bytes are additive across processes) — the per-pid view
+# (rsdl_top --dir, read_shards) keeps the unaggregated truth.
+
+_SHARD_PREFIX = "rsdl-metrics-"
+
+
+def telemetry_dir() -> Optional[str]:
+    """The federation shard directory (RSDL_TELEMETRY_DIR), or None."""
+    from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
+    return rt_policy.resolve("metrics", "telemetry_dir") or None
+
+
+def shard_path(directory: str, pid: Optional[int] = None) -> str:
+    return os.path.join(directory, f"{_SHARD_PREFIX}{pid or os.getpid()}.prom")
+
+
+def write_shard(directory: Optional[str] = None) -> Optional[str]:
+    """Atomically write THIS process's exposition as its per-pid shard;
+    returns the path (None when no directory is configured)."""
+    directory = directory or telemetry_dir()
+    if not directory:
+        return None
+    os.makedirs(directory, exist_ok=True)
+    path = shard_path(directory)
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(render())
+    os.replace(tmp, path)
+    return path
+
+
+def read_shards(directory: str, skip_pid: Optional[int] = None
+                ) -> "Dict[int, tuple]":
+    """Parse every shard in ``directory``: ``{pid: (samples, types,
+    age_s)}``. Unparseable/torn shards are skipped (the writer is atomic,
+    but a reader must survive a shard mid-replace on exotic filesystems);
+    ``age_s`` is seconds since the shard was last rewritten."""
+    out: Dict[int, tuple] = {}
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return out
+    now = time.time()
+    for name in names:
+        if not name.startswith(_SHARD_PREFIX) or not name.endswith(".prom"):
+            continue
+        try:
+            pid = int(name[len(_SHARD_PREFIX):-len(".prom")])
+        except ValueError:
+            continue
+        if skip_pid is not None and pid == skip_pid:
+            continue
+        path = os.path.join(directory, name)
+        try:
+            with open(path, encoding="utf-8") as f:
+                text = f.read()
+            samples, types = parse_exposition_typed(text)
+        except (OSError, ValueError, AssertionError):
+            continue
+        try:
+            # Shard age vs a file mtime: both are wall clock by nature
+            # (freshness display only, never a deadline).
+            # rsdl-lint: disable=wallclock-interval
+            age_s = max(0.0, now - os.stat(path).st_mtime)
+        except OSError:
+            age_s = 0.0
+        out[pid] = (samples, types, age_s)
+    return out
+
+
+def merge_series(shards: Iterable["tuple"]) -> "tuple":
+    """Sum ``(samples, types)`` pairs element-wise into one
+    ``(samples, types)``. Counter/histogram series merge exactly by
+    construction (cumulative counts add); gauges sum — the cluster-wide
+    aggregate — and the per-pid shards remain the per-process view."""
+    merged: Dict[str, Dict[Labels, float]] = {}
+    types: Dict[str, str] = {}
+    for entry in shards:
+        samples, kinds = entry[0], entry[1]
+        for name, series in samples.items():
+            into = merged.setdefault(name, {})
+            for labels, value in series.items():
+                into[labels] = into.get(labels, 0.0) + value
+        types.update(kinds)
+    return merged, types
+
+
+def federated_series() -> "tuple":
+    """``(samples, types, pids)`` of the cluster-wide view: this
+    process's LIVE registry merged with every other pid's shard under
+    the telemetry dir (no dir configured: just the live registry)."""
+    own = parse_exposition_typed(render())
+    directory = telemetry_dir()
+    pids = [os.getpid()]
+    shards = [own]
+    if directory:
+        for pid, entry in sorted(read_shards(directory,
+                                             skip_pid=os.getpid()).items()):
+            pids.append(pid)
+            shards.append(entry)
+    samples, types = merge_series(shards)
+    samples["rsdl_federated_processes"] = {(): float(len(pids))}
+    types["rsdl_federated_processes"] = "gauge"
+    return samples, types, pids
+
+
+def render_merged(samples: Dict[str, Dict[Labels, float]],
+                  types: Dict[str, str]) -> str:
+    """Render merged series back to exposition text (round-trips through
+    :func:`parse_exposition_typed`). TYPE lines are emitted per family
+    (histogram series look up their ``_bucket``/``_sum``/``_count``
+    base name)."""
+    out: List[str] = []
+    typed_done = set()
+    for name in sorted(samples):
+        base = name
+        for suffix in ("_bucket", "_sum", "_count"):
+            if name.endswith(suffix) and name[:-len(suffix)] in types:
+                base = name[:-len(suffix)]
+                break
+        if base in types and base not in typed_done:
+            typed_done.add(base)
+            out.append(f"# TYPE {base} {types[base]}")
+        for labels, value in sorted(samples[name].items()):
+            out.append(f"{name}{_format_labels(labels)} {_fmt(value)}")
+    return "\n".join(out) + "\n"
+
+
+def render_federated() -> str:
+    samples, types, _ = federated_series()
+    return render_merged(samples, types)
+
+
+_shard_writer_lock = threading.Lock()
+_shard_writer_started = False
+
+
+def maybe_start_shard_writer(interval_s: Optional[float] = None) -> bool:
+    """Start this process's periodic shard writer iff RSDL_TELEMETRY_DIR
+    is configured (idempotent; registers an atexit final flush so even a
+    short-lived worker's last counts land). Every participating process
+    — driver, procpool worker, supervised queue server — calls this at
+    startup; the env inherits through spawn/fork like RSDL_TRACE_DIR."""
+    global _shard_writer_started
+    if telemetry_dir() is None:
+        return False
+    from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
+    interval_s = rt_policy.resolve("metrics", "metrics_shard_interval_s",
+                                   override=interval_s)
+    with _shard_writer_lock:
+        if _shard_writer_started:
+            return True
+        _shard_writer_started = True
+    import atexit
+
+    def _flush() -> None:
+        try:
+            write_shard()
+        except OSError:
+            pass  # scratch volume went away at teardown; nothing to save
+
+    def _loop() -> None:
+        stop = threading.Event()
+        while not stop.wait(interval_s):
+            _flush()
+
+    atexit.register(_flush)
+    _flush()
+    threading.Thread(target=_loop, daemon=True,
+                     name="rsdl-metrics-shard").start()
+    return True
+
+
+# ---------------------------------------------------------------------------
 # Exposition transports: file + localhost HTTP
 # ---------------------------------------------------------------------------
 
 
+def _exposition_text() -> str:
+    """What the transports serve: the federated view when a telemetry
+    dir is configured (cluster-wide truth), else this registry alone."""
+    if telemetry_dir() is not None:
+        try:
+            return render_federated()
+        except (OSError, ValueError):
+            pass  # torn shard dir mid-teardown; fall back to own registry
+    return render()
+
+
 def write_file(path: str) -> str:
-    """Atomically write the current exposition to ``path``; returns it."""
+    """Atomically write the current exposition to ``path``; returns it.
+    With RSDL_TELEMETRY_DIR set this is the MERGED multi-process view."""
     directory = os.path.dirname(os.path.abspath(path))
     os.makedirs(directory, exist_ok=True)
     tmp = f"{path}.{os.getpid()}.tmp"
     with open(tmp, "w", encoding="utf-8") as f:
-        f.write(render())
+        f.write(_exposition_text())
     os.replace(tmp, path)
     return path
 
@@ -457,7 +672,7 @@ def start_http_server(port: int = 0, host: str = "127.0.0.1"):
                 self.send_response(404)
                 self.end_headers()
                 return
-            body = render().encode()
+            body = _exposition_text().encode()
             self.send_response(200)
             self.send_header("Content-Type",
                              "text/plain; version=0.0.4; charset=utf-8")
@@ -502,6 +717,9 @@ def start_exporter(path: Optional[str] = None, port: Optional[int] = None,
         if _exporter_stop is not None:
             _exporter_stop.set()
         stop = _exporter_stop = threading.Event()
+    # Join the federation as a writer too (no-op without a dir): the
+    # driver's shard is what per-pid views (rsdl_top --dir) show for it.
+    maybe_start_shard_writer()
     http_port = None
     if port is not None:
         _, http_port = start_http_server(int(port))

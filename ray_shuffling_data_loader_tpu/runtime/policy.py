@@ -104,6 +104,43 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     "metrics_file": ("", str),
     "metrics_port": (0, int),
     "metrics_interval_s": (5.0, float),
+    # Multi-process metrics federation (runtime/metrics.py): when set,
+    # EVERY process (driver, procpool workers, supervised queue servers)
+    # periodically writes a per-pid exposition shard into this directory
+    # (same inherit-via-env pattern as RSDL_TRACE_DIR), and the driver's
+    # exposition file / HTTP endpoint / rsdl_top merge the shards into
+    # cluster-wide totals with a per-pid view.
+    "telemetry_dir": ("", str),
+    "metrics_shard_interval_s": (2.0, float),
+    # Time-series history ring (runtime/history.py): periodic registry
+    # snapshots in fixed memory, ticked from the watchdog monitor thread.
+    "history_interval_s": (1.0, float),
+    "history_capacity": (600, int),
+    # Health/SLO detector engine (runtime/health.py): detectors evaluate
+    # on every history tick with hysteresis (breach must persist
+    # `health_fire_ticks` ticks to fire; `health_clear_ticks` clean ticks
+    # re-arm it) so a noisy tick cannot flap a verdict.
+    "health": (True, _parse_bool),
+    "health_fire_ticks": (3, int),
+    "health_clear_ticks": (5, int),
+    # SLO thresholds (RSDL_SLO_* via the generic env rung; component
+    # form RSDL_HEALTH_SLO_* wins over it). Detector semantics live in
+    # runtime/health.py next to each detector.
+    "slo_droop_pct": (60.0, float),        # rate below (100-x)% of peak
+    "slo_droop_floor_eps": (2.0, float),   # min peak (events/s) to judge
+    "slo_droop_window_ticks": (8, int),    # smoothing window for rates
+    "slo_stall_pct": (95.0, float),        # consumer batch-wait share
+    "slo_creep_mb_per_min": (512.0, float),  # ledger/RSS growth slope
+    "slo_queue_depth": (100000.0, float),  # per-queue item saturation
+    "slo_lease_churn_per_min": (3.0, float),
+    "slo_straggler_drift_x": (4.0, float),  # straggler vs rolling median
+    # Incident capsules (runtime/health.py): where capsule directories
+    # land ("" = trace_dir, else telemetry_dump_dir, else temp dir), how
+    # long the profiler burst samples, and how long capture waits for
+    # sibling processes to land their signal-driven trace dumps.
+    "incident_dir": ("", str),
+    "incident_profile_s": (0.25, float),
+    "incident_wait_s": (2.0, float),
     # Cross-process queue service (multiqueue_service.py) socket hygiene:
     # recv timeout applied to BOTH serve_queue connections and
     # RemoteQueue dials (0 = no timeout — a deliberate infinite wait;

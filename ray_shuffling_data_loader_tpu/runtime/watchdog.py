@@ -81,14 +81,41 @@ class WatchHandle:
             return f"<detail failed: {e}>"
 
 
+class PeriodicHandle:
+    """One registered periodic callback run by the monitor thread."""
+
+    __slots__ = ("name", "interval_s", "fn", "next_due")
+
+    def __init__(self, name: str, interval_s: float, fn: Callable[[], None]):
+        self.name = name
+        # Floor guards a zero/negative interval from busy-looping the
+        # one monitor thread every subsystem shares.
+        self.interval_s = max(0.01, float(interval_s))
+        self.fn = fn
+        self.next_due = time.monotonic() + self.interval_s
+
+
 class Watchdog:
-    """Deadline monitor: one daemon thread supervising all active watches."""
+    """Deadline monitor: one daemon thread supervising all active watches.
+
+    The same thread services registered *periodic* callbacks
+    (:meth:`every`) — the history ring tick and the health detectors ride
+    the existing supervision thread instead of each spawning their own.
+    """
 
     def __init__(self, poll_interval_s: float = 0.05):
         self.poll_interval_s = poll_interval_s
         self._cond = threading.Condition()
         self._watches: "set[WatchHandle]" = set()
+        self._periodics: "set[PeriodicHandle]" = set()
         self._thread: Optional[threading.Thread] = None
+
+    def _ensure_thread_locked(self) -> None:
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._monitor, daemon=True, name="rsdl-watchdog")
+            self._thread.start()
+        self._cond.notify_all()
 
     @contextlib.contextmanager
     def watch(self, name: str, deadline_s: float,
@@ -103,24 +130,38 @@ class Watchdog:
         handle = WatchHandle(name, deadline_s, on_stall, detail_fn)
         with self._cond:
             self._watches.add(handle)
-            if self._thread is None or not self._thread.is_alive():
-                self._thread = threading.Thread(
-                    target=self._monitor, daemon=True, name="rsdl-watchdog")
-                self._thread.start()
-            self._cond.notify_all()
+            self._ensure_thread_locked()
         try:
             yield handle
         finally:
             with self._cond:
                 self._watches.discard(handle)
 
+    def every(self, interval_s: float, fn: Callable[[], None],
+              name: str = "periodic") -> PeriodicHandle:
+        """Run ``fn`` on the monitor thread every ``interval_s`` seconds
+        until :meth:`cancel` — even while no watches are active (the
+        monitor parks only when it has neither watches nor periodics).
+        ``fn`` must be brief and must never raise for long-term health;
+        raising is survived and logged."""
+        handle = PeriodicHandle(name, interval_s, fn)
+        with self._cond:
+            self._periodics.add(handle)
+            self._ensure_thread_locked()
+        return handle
+
+    def cancel(self, handle: PeriodicHandle) -> None:
+        with self._cond:
+            self._periodics.discard(handle)
+
     def _monitor(self) -> None:
         from ray_shuffling_data_loader_tpu import stats as stats_mod
         while True:
             with self._cond:
-                if not self._watches:
-                    # Idle park; a new watch() notifies. Bounded wait only
-                    # so a torn-down interpreter lets the daemon cycle out.
+                if not self._watches and not self._periodics:
+                    # Idle park; a new watch()/every() notifies. Bounded
+                    # wait only so a torn-down interpreter lets the
+                    # daemon cycle out.
                     self._cond.wait(timeout=5.0)
                     continue
                 now = time.monotonic()
@@ -131,10 +172,30 @@ class Watchdog:
                         w.escalations += 1
                         w.stalled = True
                         due.append((w, waited, w.escalations))
-                self._cond.wait(timeout=self.poll_interval_s)
-            # Reports, logs, and escalation hooks run OUTSIDE the lock:
-            # an on_stall that takes its subsystem's locks (the degrade
-            # path does) must not be able to deadlock new watch()ers.
+                due_periodics = []
+                for p in self._periodics:
+                    if now >= p.next_due:
+                        p.next_due = now + p.interval_s
+                        due_periodics.append(p)
+                if not due and not due_periodics:
+                    # Nothing to fire this pass: sleep to the earlier of
+                    # the watch poll tick and the next periodic due time.
+                    if self._watches:
+                        timeout = self.poll_interval_s
+                    else:
+                        timeout = min(5.0, max(
+                            0.005,
+                            min(p.next_due for p in self._periodics) - now))
+                    self._cond.wait(timeout=timeout)
+            # Reports, logs, escalation hooks and periodic callbacks run
+            # OUTSIDE the lock: a callback that takes its subsystem's
+            # locks (the degrade path does) must not be able to deadlock
+            # new watch()ers.
+            for p in due_periodics:
+                try:
+                    p.fn()
+                except Exception:  # noqa: BLE001 - supervision survives
+                    logger.exception("watchdog periodic %s failed", p.name)
             for w, waited, escalation in due:
                 report = StallReport(
                     name=w.name, waited_s=waited, deadline_s=w.deadline_s,

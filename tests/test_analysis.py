@@ -400,6 +400,26 @@ COPY_HOT_PATH_OTHER_FILE_OK = """
         return arr[perm].astype(dtype)
 """
 
+UNREGISTERED_METRIC_BAD = """
+    from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+
+    def wire():
+        rt_metrics.counter("rsdl_made_up_total", "not in the catalog").inc()
+"""
+
+UNREGISTERED_METRIC_OK = """
+    from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+
+    def wire(depth):
+        # catalog names pass; derived histogram series resolve through
+        # their base name; test_*/dynamic names are out of scope
+        rt_metrics.gauge("rsdl_queue_depth", "d", queue="0").set(depth)
+        rt_metrics.get("rsdl_stage_seconds_count")
+        rt_metrics.counter("test_probe_total", "t").inc()
+        name = "rsdl_dynamic"
+        rt_metrics.get(name)
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -422,7 +442,30 @@ CASES = [
     ("span-unbalanced", SPAN_NO_FINALLY_BAD, SPAN_BALANCED_OK, {}),
     ("copy-in-hot-path", COPY_HOT_PATH_BAD, COPY_HOT_PATH_OK,
      {"path": "pkg/shuffle.py"}),
+    ("unregistered-metric", UNREGISTERED_METRIC_BAD, UNREGISTERED_METRIC_OK,
+     {"path": "ray_shuffling_data_loader_tpu/multiqueue.py"}),
 ]
+
+
+def test_unregistered_metric_scoped_to_library_code():
+    # The same uncataloged name in a test file is not flagged (tests may
+    # mint throwaway metrics); library paths are.
+    flagged, _ = lint(UNREGISTERED_METRIC_BAD, path="tests/test_x.py")
+    assert "unregistered-metric" not in flagged
+    flagged, _ = lint(UNREGISTERED_METRIC_BAD, path="bench.py")
+    assert "unregistered-metric" in flagged
+
+
+def test_metric_catalog_covers_every_registered_name():
+    """Every name in the catalog is well-formed; and the analyzer over
+    the real tree (the gate test below) proves every call site is in the
+    catalog — together: catalog == code, no silent drift."""
+    from ray_shuffling_data_loader_tpu.runtime.metric_names import (
+        METRIC_NAMES)
+    for name, (kind, labels) in METRIC_NAMES.items():
+        assert name.startswith("rsdl_"), name
+        assert kind in ("counter", "gauge", "histogram"), (name, kind)
+        assert isinstance(labels, tuple), name
 
 
 def test_copy_in_hot_path_scoped_to_hot_path_modules():

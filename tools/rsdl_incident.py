@@ -1,0 +1,199 @@
+#!/usr/bin/env python
+"""rsdl-incident: triage renderer for auto-captured incident capsules.
+
+A capsule (written by ``runtime/health.py`` when an SLO detector fires,
+or on ``SIGUSR2``) is a self-contained directory::
+
+    rsdl-incident-<pid>-<seq>[-<detector>]/
+      capsule.json    # manifest: reason, detector verdict, pids, files
+      history.json    # metrics time-series slice (rsdl-history-v1)
+      metrics.prom    # merged multi-process exposition at capture time
+      policy.json     # resolved policy snapshot + RSDL_* environment
+      profile.folded  # sampling-profiler burst (flamegraph input)
+      traces/rsdl-telemetry-<pid>-*.jsonl   # per-pid recorder dumps
+
+This tool validates the layout and renders the triage story in one
+screen: what fired and why, the activity series around the breach, the
+merged critical path across every captured pid, and where to go next
+(``rsdl_trace`` on the capsule's traces/, ``flamegraph.pl`` on the
+profile). ``--json`` emits the machine form; exit code 0 means the
+capsule parsed (1: invalid/incomplete, 2: usage).
+
+Usage::
+
+    tools/rsdl_incident.py /tmp/rsdl-incident-1234-1-throughput_droop/
+    tools/rsdl_incident.py <capsule> --json
+
+Stdlib-only: loads ``runtime/trace.py`` / ``runtime/history.py`` by
+file path (the rsdl_top pattern), so it runs on hosts without
+numpy/pyarrow/jax.
+"""
+
+import argparse
+import glob
+import importlib.util
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_RUNTIME = os.path.join(_REPO_ROOT, "ray_shuffling_data_loader_tpu",
+                        "runtime")
+
+
+def _load_by_path(stem: str):
+    """Load a runtime/ module WITHOUT importing the package (whose
+    __init__ pulls numpy/pyarrow); the runtime/ modules are stdlib-only.
+    Modules that import siblings via the package path get the package
+    pre-aliased to stubs only when the real package is unavailable."""
+    try:
+        import importlib
+        return importlib.import_module(
+            f"ray_shuffling_data_loader_tpu.runtime.{stem}")
+    except ImportError:
+        spec = importlib.util.spec_from_file_location(
+            f"_rsdl_{stem}", os.path.join(_RUNTIME, f"{stem}.py"))
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return module
+
+
+def load_capsule(path: str) -> dict:
+    """Parse + validate one capsule directory; raises ValueError on an
+    invalid/incomplete capsule."""
+    manifest_path = os.path.join(path, "capsule.json")
+    if not os.path.isfile(manifest_path):
+        raise ValueError(f"no capsule.json under {path!r} — not a capsule")
+    with open(manifest_path, encoding="utf-8") as f:
+        manifest = json.load(f)
+    if manifest.get("schema") != "rsdl-incident-v1":
+        raise ValueError(
+            f"unknown capsule schema {manifest.get('schema')!r}")
+    traces = sorted(glob.glob(os.path.join(path, "traces", "*.jsonl")))
+    if not traces:
+        raise ValueError("capsule has no trace dumps under traces/")
+    out = {"path": path, "manifest": manifest, "traces": traces}
+    history_path = os.path.join(path, "history.json")
+    if os.path.isfile(history_path):
+        with open(history_path, encoding="utf-8") as f:
+            out["history"] = json.load(f)
+        if out["history"].get("schema") != "rsdl-history-v1":
+            raise ValueError("history.json is not an rsdl-history-v1 slice")
+    policy_path = os.path.join(path, "policy.json")
+    if os.path.isfile(policy_path):
+        with open(policy_path, encoding="utf-8") as f:
+            out["policy"] = json.load(f)
+    return out
+
+
+def analyze_traces(capsule: dict) -> dict:
+    """Merged multi-pid trace analysis over the capsule's dumps."""
+    trace = _load_by_path("trace")
+    merged = trace.merge_dumps(capsule["traces"])
+    pids = sorted({m["pid"] for m in merged["processes"]})
+    analysis = trace.analyze(merged["events"]) if merged["events"] else None
+    return {"pids": pids, "processes": merged["processes"],
+            "analysis": analysis}
+
+
+def _sparkline(values, width: int = 40) -> str:
+    """Unicode block sparkline (terminal triage; the HTML report has the
+    real charts)."""
+    if not values:
+        return ""
+    blocks = " ▁▂▃▄▅▆▇█"
+    lo, hi = min(values), max(values)
+    span = (hi - lo) or 1.0
+    if len(values) > width:
+        stride = len(values) / width
+        values = [values[int(i * stride)] for i in range(width)]
+    return "".join(blocks[1 + int((v - lo) / span * (len(blocks) - 2))]
+                   for v in values)
+
+
+def activity_series(capsule: dict) -> list:
+    """Per-tick activity rate from the embedded history slice."""
+    if "history" not in capsule:
+        return []
+    history = _load_by_path("history")
+    ring = history.load_slice(capsule["history"])
+    rates = ring.rate("rsdl_events_total", window_ticks=1)
+    return [rate for _, rate in rates]
+
+
+def render(capsule: dict, traced: dict) -> str:
+    manifest = capsule["manifest"]
+    verdict = manifest.get("verdict") or {}
+    lines = []
+    lines.append(f"incident capsule: {capsule['path']}")
+    lines.append(f"reason: {manifest.get('reason')}   "
+                 f"captured by pid {manifest.get('pid')} on "
+                 f"{manifest.get('host')}")
+    if verdict:
+        lines.append(
+            f"detector: {verdict.get('detector')}  value "
+            f"{verdict.get('value')} vs threshold "
+            f"{verdict.get('threshold')}  (episode {verdict.get('fires')})")
+        if verdict.get("detail"):
+            lines.append(f"  {verdict['detail']}")
+    lines.append(f"trace dumps: {len(capsule['traces'])} file(s) from "
+                 f"{len(traced['pids'])} pid(s) {traced['pids']}")
+    rates = activity_series(capsule)
+    if rates:
+        lines.append(f"activity (events/s over the history window, "
+                     f"{len(rates)} ticks):")
+        lines.append(f"  {_sparkline(rates)}  "
+                     f"[{min(rates):.0f} .. {max(rates):.0f}]")
+    analysis = traced.get("analysis")
+    if analysis and analysis.get("critical_path"):
+        top = analysis["critical_path"][:3]
+        lines.append("critical path: " + " > ".join(
+            f"{e['stage']} {e['cp_ms']:.0f}ms" for e in top))
+        stragglers = [s for s in analysis.get("stragglers", [])
+                      if s.get("cp_ms", 0) > 0][:3]
+        for i, s in enumerate(stragglers):
+            lines.append(f"  straggler {i + 1}: {s['stage']} task "
+                         f"{s['task']} ({s['cp_ms']:.0f}ms on path)")
+    lines.append("")
+    lines.append("next steps:")
+    lines.append(f"  tools/rsdl_trace.py {capsule['path']}/traces/")
+    if os.path.isfile(os.path.join(capsule["path"], "profile.folded")):
+        lines.append(f"  flamegraph.pl {capsule['path']}/profile.folded "
+                     "> flame.svg")
+    lines.append(f"  tools/rsdl_report.py --capsule {capsule['path']} "
+                 "-o report.html")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="validate + render an rsdl incident capsule")
+    parser.add_argument("capsule", help="capsule directory")
+    parser.add_argument("--json", action="store_true",
+                        help="machine-readable summary")
+    args = parser.parse_args(argv)
+    try:
+        capsule = load_capsule(args.capsule)
+        traced = analyze_traces(capsule)
+    except (ValueError, OSError) as e:
+        print(f"invalid capsule: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        analysis = traced.get("analysis") or {}
+        print(json.dumps({
+            "path": capsule["path"],
+            "reason": capsule["manifest"].get("reason"),
+            "verdict": capsule["manifest"].get("verdict"),
+            "pids": traced["pids"],
+            "traces": [os.path.basename(t) for t in capsule["traces"]],
+            "critical_path": analysis.get("critical_path"),
+            "stragglers": (analysis.get("stragglers") or [])[:5],
+            "activity_rates": activity_series(capsule),
+        }))
+    else:
+        print(render(capsule, traced))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

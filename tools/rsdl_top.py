@@ -12,6 +12,14 @@ Usage::
     tools/rsdl_top.py --file /run/rsdl.prom            # refresh loop
     tools/rsdl_top.py --url http://127.0.0.1:9200/metrics
     tools/rsdl_top.py --file /run/rsdl.prom --once     # one snapshot
+    tools/rsdl_top.py --dir /run/rsdl-shards           # federated view
+
+``--dir`` (default ``$RSDL_TELEMETRY_DIR``) reads the per-pid metric
+shards every federated process writes (driver, procpool workers,
+supervised queue servers), renders the table over the MERGED totals,
+and appends a per-process line for every shard — pool-worker pids (the
+``rsdl_executor_worker_up`` gauge) are marked, so the processes doing
+the map/reduce work are visible instead of under-counted.
 
 Stdlib-only: the exposition parser is loaded straight from
 ``runtime/metrics.py`` by file path, so this tool runs on hosts without
@@ -56,6 +64,35 @@ def read_exposition(file: str = None, url: str = None) -> dict:
             return parse_exposition(resp.read().decode())
     with open(file, encoding="utf-8") as f:
         return parse_exposition(f.read())
+
+
+def read_shard_dir(directory: str) -> "tuple[dict, dict]":
+    """``(merged_samples, per_pid_shards)`` over a federation shard dir
+    (runtime/metrics.py read_shards/merge_series, loaded by path)."""
+    shards = _metrics.read_shards(directory)
+    merged, _ = _metrics.merge_series(list(shards.values()))
+    return merged, shards
+
+
+def render_processes(shards: dict, merged: dict) -> str:
+    """Per-process lines: one row per shard pid, pool-worker pids (the
+    rsdl_executor_worker_up gauge) marked — the blind spot the
+    federation exists to close."""
+    worker_pids = {dict(labels).get("pid")
+                   for labels, value in merged.get(
+                       "rsdl_executor_worker_up", {}).items()
+                   if value >= 1}
+    header = (f"{'pid':<9} {'role':<8} {'events':>10} {'tasks':>7} "
+              f"{'stage s':>9} {'age':>6}")
+    lines = ["", header, "-" * len(header)]
+    for pid, (samples, _types, age_s) in sorted(shards.items()):
+        events = sum(samples.get("rsdl_events_total", {}).values())
+        tasks = sum(samples.get("rsdl_worker_tasks_total", {}).values())
+        stage_s = sum(samples.get("rsdl_stage_seconds_sum", {}).values())
+        role = "worker" if str(pid) in worker_pids else "proc"
+        lines.append(f"{pid:<9} {role:<8} {int(events):>10} "
+                     f"{int(tasks):>7} {stage_s:>9.2f} {age_s:>5.0f}s")
+    return "\n".join(lines)
 
 
 def _series(parsed: dict, name: str, **want) -> dict:
@@ -218,21 +255,35 @@ def main(argv=None) -> int:
     parser.add_argument("--url", default=None,
                         help="exposition HTTP URL, e.g. "
                              "http://127.0.0.1:9200/metrics")
+    parser.add_argument("--dir", default=os.environ.get(
+        "RSDL_TELEMETRY_DIR") or None,
+        help="federation shard directory: merged table + per-process "
+             "lines (default: $RSDL_TELEMETRY_DIR)")
     parser.add_argument("--interval", type=float, default=2.0,
                         help="refresh seconds (default 2)")
     parser.add_argument("--once", action="store_true",
                         help="print one lifetime-totals snapshot and exit")
     args = parser.parse_args(argv)
-    if not args.file and not args.url:
-        parser.error("need --file or --url (or set RSDL_METRICS_FILE)")
+    if not args.file and not args.url and not args.dir:
+        parser.error("need --file, --url or --dir "
+                     "(or set RSDL_METRICS_FILE / RSDL_TELEMETRY_DIR)")
+
+    def _read():
+        if args.file or args.url:
+            parsed = read_exposition(args.file, args.url)
+            shards = (_metrics.read_shards(args.dir) if args.dir else {})
+            return parsed, shards
+        return read_shard_dir(args.dir)
 
     try:
-        parsed = read_exposition(args.file, args.url)
+        parsed, shards = _read()
     except (OSError, ValueError) as e:
         print(f"cannot read exposition: {e}", file=sys.stderr)
         return 1
     if args.once:
         print(render(parsed))
+        if shards:
+            print(render_processes(shards, parsed))
         return 0
     before = parsed
     # Monotonic interval timing (the exposition may come from another
@@ -245,13 +296,15 @@ def main(argv=None) -> int:
         while True:
             time.sleep(args.interval)
             try:
-                parsed = read_exposition(args.file, args.url)
+                parsed, shards = _read()
             except (OSError, ValueError) as e:
                 print(f"read failed: {e}", file=sys.stderr)
                 continue
             now = time.monotonic()
             sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
             print(render(parsed, before=before, interval_s=now - last))
+            if shards:
+                print(render_processes(shards, parsed))
             sys.stdout.flush()
             before, last = parsed, now
     except KeyboardInterrupt:
