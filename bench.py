@@ -959,6 +959,123 @@ def _run_process_recovery_soak(seed: int) -> dict:
     return result
 
 
+def _run_speculation_leg(seed: int) -> dict:
+    """Straggler leg of ``--chaos``: an injected ``delayN`` straggler on
+    one reduce task (targeted FROM the epoch plan via
+    ``faults.spec_for_node`` — the chaos key and the task's lineage key
+    are equal by construction), raced with speculation ON vs OFF at the
+    same seed over several rounds. The contract the record carries:
+    p99 epoch time improves with speculation on, the consumed stream is
+    bit-identical either way, and at least one backup actually won.
+
+    Runs on the THREAD backend deliberately: chaos key state is
+    per-process, so a process-pool backup in a sibling worker would
+    re-fire the injected delay and prove nothing (the process-backend
+    first-wins contract is pinned in tests/test_plan.py instead — the
+    bench host has 1 CPU).
+    """
+    import statistics
+    import tempfile
+
+    from ray_shuffling_data_loader_tpu import data_generation as datagen
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+    from ray_shuffling_data_loader_tpu.plan import scheduler as plan_sched
+    from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+    from ray_shuffling_data_loader_tpu.shuffle import shuffle as run_shuffle
+
+    reducers, trainers, rounds, delay_ms = 3, 1, 5, 500
+    tmpdir = tempfile.mkdtemp(prefix="rsdl-spec-leg-")
+    filenames, _ = datagen.generate_data_local(6_000, 2, 1, 0.0, tmpdir)
+    plan = plan_ir.build_epoch_plan(filenames, reducers, trainers,
+                                    seed, epoch=0)
+    straggler = plan.reduces()[0]
+    rule = rt_faults.spec_for_node("reduce_gather", straggler,
+                                   delay_ms=delay_ms)
+
+    spec_env = {"RSDL_PLAN_SPECULATION": "1",
+                "RSDL_PLAN_SPECULATION_MIN_S": "0.15",
+                "RSDL_PLAN_SPECULATION_MULTIPLIER": "2.0",
+                "RSDL_PLAN_SPECULATION_CHECK_S": "0.02"}
+
+    def run_rounds(speculate: bool):
+        """Per round: epoch time = start -> the consumer holds every
+        reducer table of the epoch (the p99 the contract is about — a
+        losing backup's discarded sleep drains during pool shutdown and
+        is deliberately NOT part of epoch time)."""
+        durations, streams = [], []
+        for round_i in range(rounds):
+            # Fresh injector per round: the delay rule fires once per
+            # (site, epoch, task) key per injector, and every round must
+            # see the same straggler.
+            rt_faults.install(rule, seed=seed)
+            try:
+                stream: list = []
+                done = {"t": None}
+                start = time.monotonic()
+
+                def consumer(rank, epoch, refs):
+                    if refs is None:
+                        return
+                    for ref in refs:
+                        stream.extend(
+                            ref.result().column("key").to_pylist())
+                    done["t"] = time.monotonic() - start
+
+                run_shuffle(filenames, consumer, 1,
+                            num_reducers=reducers, num_trainers=trainers,
+                            max_concurrent_epochs=1, seed=seed,
+                            collect_stats=False, file_cache=None,
+                            num_workers=4, executor_backend="thread")
+                durations.append(done["t"])
+                streams.append(tuple(stream))
+            finally:
+                rt_faults.clear()
+        return durations, streams
+
+    totals_before = plan_sched.speculation_totals()
+    for key, value in spec_env.items():
+        os.environ[key] = value
+    try:
+        on_durations, on_streams = run_rounds(True)
+    finally:
+        for key in spec_env:
+            os.environ.pop(key, None)
+    totals_after = plan_sched.speculation_totals()
+    off_durations, off_streams = run_rounds(False)
+
+    def p99(values):
+        ordered = sorted(values)
+        return ordered[min(len(ordered) - 1,
+                           int(0.99 * (len(ordered) - 1) + 0.999))]
+
+    identical = len(set(on_streams + off_streams)) == 1
+    won = (totals_after["speculative_won"]
+           - totals_before["speculative_won"])
+    result = {
+        "rounds": rounds,
+        "straggler": {"site": "reduce_gather", "rule": rule,
+                      "node": straggler.id, "delay_ms": delay_ms},
+        "p99_epoch_s_speculation_on": round(p99(on_durations), 4),
+        "p99_epoch_s_speculation_off": round(p99(off_durations), 4),
+        "median_epoch_s_speculation_on": round(
+            statistics.median(on_durations), 4),
+        "median_epoch_s_speculation_off": round(
+            statistics.median(off_durations), 4),
+        "p99_improvement_pct": round(
+            100.0 * (1.0 - p99(on_durations) / p99(off_durations)), 2)
+        if p99(off_durations) > 0 else 0.0,
+        "speculative_launched": (totals_after["speculative_launched"]
+                                 - totals_before["speculative_launched"]),
+        "speculative_won": won,
+        "speculative_wasted": (totals_after["speculative_wasted"]
+                               - totals_before["speculative_wasted"]),
+        "output_bit_identical": identical,
+    }
+    result["ok"] = bool(identical and won >= 1
+                        and p99(on_durations) < p99(off_durations))
+    return result
+
+
 def main() -> None:
     if os.environ.get("RSDL_BENCH_CPU"):
         os.environ.setdefault(
@@ -1406,6 +1523,36 @@ def main() -> None:
                   f" server_restarts={process_soak['server_restarts']}"
                   f" lease_drained={process_soak.get('lease_drained')}",
                   file=sys.stderr)
+    # Speculation evidence (plan/scheduler.py): always report the plan
+    # scheduler's process-wide race/steal totals; under --chaos, run the
+    # injected-straggler leg and fold its p99 on-vs-off verdict in.
+    from ray_shuffling_data_loader_tpu.plan import (
+        scheduler as plan_scheduler)
+    record["speculation"] = {
+        "enabled": bool(rt_policy.resolve("plan", "plan_speculation")),
+        "stealing": bool(rt_policy.resolve("plan", "plan_stealing")),
+        **plan_scheduler.speculation_totals(),
+    }
+    speculation_leg = None
+    if chaos_rate is not None:
+        speculation_leg = _phase(
+            "speculation-straggler-leg",
+            lambda: _run_speculation_leg(
+                int(os.environ.get("RSDL_CHAOS_SEED", "0")) + 17))
+        if speculation_leg:
+            record["speculation"]["straggler_leg"] = speculation_leg
+            # The leg's races land in the process-wide totals; refresh
+            # so the block's counters cover the whole invocation.
+            record["speculation"].update(
+                plan_scheduler.speculation_totals())
+            print("# speculation leg: p99 "
+                  f"{speculation_leg['p99_epoch_s_speculation_off']}s off"
+                  f" -> {speculation_leg['p99_epoch_s_speculation_on']}s"
+                  f" on ({speculation_leg['p99_improvement_pct']}%),"
+                  f" won={speculation_leg['speculative_won']}"
+                  f" bit_identical="
+                  f"{speculation_leg['output_bit_identical']}",
+                  file=sys.stderr)
     # Telemetry-spine evidence (runtime/telemetry.py): the bottleneck
     # verdict and per-stage latency decomposition are computed from
     # flight-recorder events — not from log scraping — plus the
@@ -1535,6 +1682,11 @@ def main() -> None:
         if not (process_soak and process_soak.get("ok")):
             print("# chaos soak FAILED: process-recovery soak did not "
                   "recover a bit-identical stream", file=sys.stderr)
+            sys.exit(1)
+        if not (speculation_leg and speculation_leg.get("ok")):
+            print("# chaos soak FAILED: speculation straggler leg did "
+                  "not improve p99 with a bit-identical stream "
+                  f"({speculation_leg})", file=sys.stderr)
             sys.exit(1)
         print(f"# chaos soak OK: {fs_delta['injected']} injected, "
               f"{fs_delta['recomputes']} recomputed, "

@@ -35,6 +35,13 @@ else
     echo "-- rsdl-lint deps not importable, skipping"
 fi
 
+# Epoch-plan IR self-test (tools/rsdl_plan.py, stdlib-only): builds a
+# demo plan, round-trips it through JSON byte-stably, and proves the
+# validator rejects a corrupt lineage key — so a schema drift in
+# plan/ir.py surfaces here before any consumer trips over it.
+echo "-- rsdl-plan (check mode)"
+python tools/rsdl_plan.py --check >/dev/null
+
 # Stage microbenchmarks (tools/rsdl_microbench.py): per-kernel numbers
 # (parquet decode, partition plan, fused gather, shm IPC handoff) in
 # informational mode, so a kernel-level regression surfaces before the

@@ -103,6 +103,17 @@ class Config:
     # throwaway test_* names, which the rule ignores by prefix anyway).
     metric_catalog_globs: Tuple[str, ...] = (
         "ray_shuffling_data_loader_tpu/*", "bench.py")
+    # fnmatch patterns of library files where fresh (seed, epoch, task)
+    # key-derivation arithmetic is a lineage-outside-plan violation —
+    # resume/recovery must query plan/ir.py, not re-derive keys.
+    lineage_plan_globs: Tuple[str, ...] = (
+        "ray_shuffling_data_loader_tpu/*",)
+    # Files exempt from lineage-outside-plan: the plan IR itself (the
+    # one home of the arithmetic) and the RNG-stream primitive the plan
+    # contract is defined in terms of.
+    lineage_plan_exempt_globs: Tuple[str, ...] = (
+        "*ray_shuffling_data_loader_tpu/plan/*",
+        "*ray_shuffling_data_loader_tpu/ops/partition.py")
 
     @classmethod
     def from_dict(cls, data: dict) -> "Config":
@@ -149,7 +160,8 @@ def all_rules() -> Dict[str, Rule]:
     """The registry, with the built-in rule modules imported."""
     from ray_shuffling_data_loader_tpu.analysis import (  # noqa: F401
         rules_arrow, rules_executor, rules_hygiene, rules_jax, rules_lock,
-        rules_metrics, rules_perf, rules_runtime, rules_telemetry)
+        rules_metrics, rules_perf, rules_plan, rules_runtime,
+        rules_telemetry)
     return dict(_REGISTRY)
 
 

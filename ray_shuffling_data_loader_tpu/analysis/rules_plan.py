@@ -1,0 +1,129 @@
+"""Lineage-key discipline: derivation belongs to ``plan/``.
+
+PR 9 reified the pipeline's determinism contract as an explicit epoch
+plan (plan/ir.py): the route-key arithmetic
+(``queue = epoch * num_trainers + rank`` and its ``//`` / ``%``
+inverses) and the per-task lineage RNG streams live in exactly one
+place, and every resume/recovery/chaos consumer queries the plan. The
+historical failure mode was drift: five modules each re-deriving the
+same keys with private arithmetic, where one edited formula silently
+de-synchronizes replay from delivery. ``lineage-outside-plan`` pins the
+invariant mechanically: fresh key-derivation arithmetic in library code
+outside ``plan/`` (and the RNG primitive ``ops/partition.py``) is
+flagged — call ``plan.ir.queue_index`` / ``queue_epoch`` /
+``queue_rank`` / ``resume_from_watermarks`` instead.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ray_shuffling_data_loader_tpu.analysis.core import (FileContext, Rule,
+                                                         Violation,
+                                                         register)
+
+
+def _name_words(node: ast.AST) -> Set[str]:
+    """Lower-cased identifier words reachable in a subtree (Name ids and
+    Attribute attrs) — ``self._num_trainers`` contributes
+    ``_num_trainers``."""
+    words: Set[str] = set()
+    for child in ast.walk(node):
+        if isinstance(child, ast.Name):
+            words.add(child.id.lower())
+        elif isinstance(child, ast.Attribute):
+            words.add(child.attr.lower())
+    return words
+
+
+def _mentions(words: Set[str], stem: str) -> bool:
+    return any(stem in w for w in words)
+
+
+@register
+class LineageOutsidePlanRule(Rule):
+    id = "lineage-outside-plan"
+    category = "plan"
+    description = ("fresh (seed, epoch, task) key-derivation arithmetic "
+                   "outside plan/ — resume/recovery must query the epoch "
+                   "plan (plan.ir.queue_index/queue_epoch/queue_rank/"
+                   "resume_from_watermarks), not re-derive keys that can "
+                   "drift from the engine's")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        if not ctx.path_matches(ctx.config.lineage_plan_globs):
+            return
+        if ctx.path_matches(ctx.config.lineage_plan_exempt_globs):
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.BinOp):
+                violation = self._check_binop(node, ctx)
+                if violation is not None:
+                    yield violation
+            elif isinstance(node, ast.Call):
+                violation = self._check_seedseq(node, ctx)
+                if violation is not None:
+                    yield violation
+
+    def _check_binop(self, node: ast.BinOp,
+                     ctx: FileContext):
+        # Forward derivation: `epoch * num_trainers + rank` — an Add
+        # whose subtree multiplies an epoch-ish name by a trainer-count
+        # name and offsets by a rank-ish name.
+        if isinstance(node.op, ast.Add):
+            for mult, other in ((node.left, node.right),
+                                (node.right, node.left)):
+                if not (isinstance(mult, ast.BinOp)
+                        and isinstance(mult.op, ast.Mult)):
+                    continue
+                mult_words = _name_words(mult)
+                other_words = _name_words(other)
+                if (_mentions(mult_words, "epoch")
+                        and _mentions(mult_words, "trainer")
+                        and _mentions(other_words, "rank")):
+                    return ctx.violation(
+                        self, node,
+                        "queue-route key derived inline "
+                        "(epoch * num_trainers + rank); use "
+                        "plan.ir.queue_index(epoch, rank, num_trainers)")
+        # Inverse derivation: `queue_idx // num_trainers` (epoch) and
+        # `queue_idx % num_trainers` (rank). Keyed on the trainer-COUNT
+        # name specifically: dividing by e.g. `trainers_per_host` is a
+        # topology mapping, not a queue-route key.
+        if isinstance(node.op, (ast.FloorDiv, ast.Mod)):
+            right_words = _name_words(node.right)
+            if _mentions(right_words, "num_trainers"):
+                helper = ("queue_epoch" if isinstance(node.op, ast.FloorDiv)
+                          else "queue_rank")
+                return ctx.violation(
+                    self, node,
+                    "queue-route key inverted inline "
+                    f"(queue {'//' if helper == 'queue_epoch' else '%'} "
+                    "num_trainers); use "
+                    f"plan.ir.{helper}(queue_idx, num_trainers)")
+        return None
+
+    def _check_seedseq(self, node: ast.Call, ctx: FileContext):
+        # A fresh per-task lineage RNG stream: SeedSequence keyed by BOTH
+        # a seed and an epoch. The only blessed homes are ops/partition.py
+        # (the primitive) and plan/ — anything else is a private lineage
+        # stream recovery cannot reproduce by querying the plan.
+        func = node.func
+        name = (func.attr if isinstance(func, ast.Attribute)
+                else func.id if isinstance(func, ast.Name) else "")
+        if name != "SeedSequence":
+            return None
+        words = _name_words(ast.Module(body=[ast.Expr(value=arg)
+                                             for arg in node.args],
+                                       type_ignores=[]))
+        for kw in node.keywords:
+            words |= _name_words(kw.value)
+        if _mentions(words, "seed") and _mentions(words, "epoch"):
+            return ctx.violation(
+                self, node,
+                "fresh (seed, epoch, ...) SeedSequence stream outside "
+                "plan/ops — derive task RNG through the plan's lineage "
+                "keys (ops.partition map_rng/reduce_rng)")
+        return None

@@ -166,6 +166,17 @@ class WatermarkJournal:
                         done=bool(entry["done"]))
         return state
 
+    def resume_plan(self, num_epochs: int, num_trainers: int
+                    ) -> "tuple[int, Dict[int, int]]":
+        """``(start_epoch, skip_items)`` for a producer resuming against
+        this journal — delegated to the epoch-plan query
+        (``plan.ir.resume_from_watermarks``), the single home of the
+        journal-resume math the restarted queue server and the tests
+        both consult."""
+        from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+        return plan_ir.resume_from_watermarks(self.load(self._path),
+                                              num_epochs, num_trainers)
+
     def compact(self) -> None:
         """Rewrite the journal as one latest record per queue, atomic
         tmp + fsync + rename (the LoaderCheckpoint discipline) — run at

@@ -35,6 +35,7 @@ from ray_shuffling_data_loader_tpu import spill
 # __init__ rebinds that attribute to the shuffle() function, so attribute
 # import resolves differently under ``python -m`` than under package import.
 sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
+from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
 from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.utils.config import default_num_reducers
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
@@ -92,8 +93,10 @@ def batch_consumer(queue: mq.MultiQueue,
                    epoch: int,
                    batches: Optional[Sequence[ex.TaskRef]]) -> None:
     """Glue given to the shuffler: route reducer refs into the right queue
-    (reference: dataset.py:213-224). ``None`` is the epoch-end sentinel."""
-    queue_idx = epoch * num_trainers + rank
+    (reference: dataset.py:213-224). ``None`` is the epoch-end sentinel.
+    The queue index is a plan query (plan/ir.py) — the one home of the
+    route-key arithmetic the ``lineage-outside-plan`` lint rule pins."""
+    queue_idx = plan_ir.queue_index(epoch, rank, num_trainers)
     if batches is None:
         queue.put(queue_idx, None)
     else:
@@ -310,7 +313,8 @@ class ShufflingDataset:
         skip_rows = self._skip_batches * self._batch_size  # rows, not batches
         to_skip = skip_rows
         self._skip_batches = 0
-        queue_idx = self._epoch * self._num_trainers + self._rank
+        queue_idx = plan_ir.queue_index(self._epoch, self._rank,
+                                        self._num_trainers)
         # Positioned gets (multiqueue_service.RemoteQueue) return the
         # table's absolute row offset in the queue's stream. A replaying
         # queue legally restarts the stream mid-epoch (at the consumer's

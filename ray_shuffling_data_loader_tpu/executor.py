@@ -52,6 +52,12 @@ class TaskRef:
     def cancel(self) -> bool:
         return self._future.cancel()
 
+    def add_done_callback(self, fn) -> None:
+        """Invoke ``fn(future)`` when the task completes (immediately if
+        it already has) — the completion-event hook the plan scheduler's
+        dependency-ordered dispatch rides on (plan/scheduler.py)."""
+        self._future.add_done_callback(fn)
+
 
 def get(refs, timeout: Optional[float] = None):
     """Resolve a TaskRef or list of TaskRefs to values (ray.get parity)."""

@@ -109,6 +109,7 @@ import pyarrow as pa
 
 from ray_shuffling_data_loader_tpu import multiqueue as mq
 from ray_shuffling_data_loader_tpu.dataset import ShuffleFailure
+from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
 from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
 from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
@@ -401,7 +402,7 @@ class QueueServer:
     # -- frame building / serving -------------------------------------------
 
     def _epoch_of(self, queue_idx: int) -> int:
-        return queue_idx // self._num_trainers
+        return plan_ir.queue_epoch(queue_idx, self._num_trainers)
 
     def _apply_ack(self, queue_idx: int, state: _QueueState,
                    ack: int) -> None:
@@ -650,7 +651,8 @@ class QueueServer:
             # loudly rather than silently shuffling for nobody.
             self.close()
             return
-        ranks = {q % self._num_trainers for q in lease.queues}
+        ranks = {plan_ir.queue_rank(q, self._num_trainers)
+                 for q in lease.queues}
         with self._lease_lock:
             ranks -= self._drained_ranks
             self._drained_ranks |= ranks
@@ -664,7 +666,7 @@ class QueueServer:
     def _survivor_rank(self) -> Optional[int]:
         with self._lease_lock:
             ranks = sorted(
-                q % self._num_trainers
+                plan_ir.queue_rank(q, self._num_trainers)
                 for lease in self._leases.values() if not lease.expired
                 for q in lease.queues)
         for rank in ranks:
@@ -676,8 +678,9 @@ class QueueServer:
         """Free (or reroute) a dead consumer's queues so producers are
         unblocked and its tables don't leak until process exit."""
         num_queues = self._queue.num_queues
-        dead_queues = [q for q in range(num_queues)
-                       if q % self._num_trainers in ranks]
+        dead_queues = [
+            q for q in range(num_queues)
+            if plan_ir.queue_rank(q, self._num_trainers) in ranks]
         for q in dead_queues:
             state = self._state(q)
             with state.lock:
@@ -1152,17 +1155,10 @@ def _resume_plan(state: Dict[int, object], num_epochs: int,
     """``(start_epoch, skip_items)`` from a loaded journal: the first
     epoch any rank has not fully consumed, and per-queue counts of items
     (tables + sentinel) already delivered that the re-run must not
-    re-enqueue."""
-    start_epoch = num_epochs
-    for rank in range(num_trainers):
-        for epoch in range(num_epochs):
-            entry = state.get(epoch * num_trainers + rank)
-            if entry is None or not entry.done:
-                start_epoch = min(start_epoch, epoch)
-                break
-    skip_items = {q: entry.seq + 1 for q, entry in state.items()
-                  if q // num_trainers >= start_epoch}
-    return start_epoch, skip_items
+    re-enqueue. The math is a plan query
+    (``plan.ir.resume_from_watermarks``) — the server no longer carries
+    private resume arithmetic; this wrapper keeps the historical name."""
+    return plan_ir.resume_from_watermarks(state, num_epochs, num_trainers)
 
 
 def _resuming_batch_consumer(queue: mq.MultiQueue, num_trainers: int,
@@ -1175,7 +1171,7 @@ def _resuming_batch_consumer(queue: mq.MultiQueue, num_trainers: int,
     lock = threading.Lock()
 
     def consumer(rank, epoch, refs):
-        queue_idx = epoch * num_trainers + rank
+        queue_idx = plan_ir.queue_index(epoch, rank, num_trainers)
         with lock:
             to_skip = remaining.get(queue_idx, 0)
             if refs is None:

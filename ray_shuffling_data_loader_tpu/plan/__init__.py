@@ -1,0 +1,22 @@
+"""Epoch-plan subsystem: the declarative IR (plan/ir.py — stdlib-only,
+loadable standalone by tools) and the speculative, work-stealing
+execution engine (plan/scheduler.py)."""
+
+from ray_shuffling_data_loader_tpu.plan.ir import (EpochPlan, LineageKey,
+                                                   PlanError, PlanNode,
+                                                   build_epoch_plan,
+                                                   from_json, node_id,
+                                                   queue_epoch, queue_index,
+                                                   queue_rank,
+                                                   resume_from_watermarks,
+                                                   route_slices)
+from ray_shuffling_data_loader_tpu.plan.scheduler import (PlanScheduler,
+                                                          SchedulerPolicy,
+                                                          speculation_totals)
+
+__all__ = [
+    "EpochPlan", "LineageKey", "PlanError", "PlanNode", "PlanScheduler",
+    "SchedulerPolicy", "build_epoch_plan", "from_json", "node_id",
+    "queue_epoch", "queue_index", "queue_rank", "resume_from_watermarks",
+    "route_slices", "speculation_totals",
+]

@@ -379,6 +379,20 @@ def _worker_task_ping(payload: dict) -> dict:
     return {"pid": os.getpid(), "worker_index": payload.get("worker_index")}
 
 
+def _run_worker_task(kind: str, payload: dict) -> Any:
+    """Dispatch one task body; speculative backup attempts (plan
+    scheduler first-completion-wins duplicates, ``payload["attempt"]``)
+    run under ``telemetry.speculative()`` so their recorder events carry
+    the ``spec`` attr and never double-count in trace merge or
+    attribution."""
+    handler = _TASK_HANDLERS[kind]
+    attempt = payload.get("attempt", 0) if isinstance(payload, dict) else 0
+    if attempt:
+        with rt_telemetry.speculative(attempt):
+            return handler(payload)
+    return handler(payload)
+
+
 _TASK_HANDLERS: Dict[str, Callable[[dict], Any]] = {
     "map": _worker_task_map,
     "reduce": _worker_task_reduce,
@@ -430,7 +444,7 @@ def _worker_main(conn, worker_index: int) -> None:
             break
         task_id, kind, payload = msg
         try:
-            result = _TASK_HANDLERS[kind](payload)
+            result = _run_worker_task(kind, payload)
             reply = (task_id, True, result)
             tasks_done.inc()
         except (KeyboardInterrupt, SystemExit):
@@ -903,11 +917,8 @@ class ProcessPoolExecutor:
 # ---------------------------------------------------------------------------
 
 
-def process_epoch(epoch: int,
-                  filenames: Sequence[str],
-                  num_reducers: int,
+def process_epoch(plan,
                   pool: ProcessPoolExecutor,
-                  seed: int,
                   stats_collector=None,
                   map_transform_blob: Optional[bytes] = None,
                   reduce_transform_blob: Optional[bytes] = None,
@@ -915,22 +926,32 @@ def process_epoch(epoch: int,
                   gather_threads: Optional[int] = None,
                   on_bad_file: str = "raise",
                   spill_recompute_factory=None) -> List[ProcTaskRef]:
-    """Launch one epoch's map/reduce on the process pool; returns reducer
-    refs whose ``result()`` is a driver-mmap'd (then accounted / possibly
-    spilled / trace-stamped) table — the same contract as the thread-mode
-    ``_reduce_task`` refs.
+    """Execute one epoch's :class:`plan.ir.EpochPlan` on the process
+    pool; returns reducer refs whose ``result()`` is a driver-mmap'd
+    (then accounted / possibly spilled / trace-stamped) table — the same
+    contract as the thread-mode ``_reduce_task`` refs.
 
-    Maps are awaited before reduces are submitted (reduce payloads name
-    the map segments); epoch pipelining still overlaps production with
-    consumption because the shuffle driver launches epochs from its own
-    thread. A map task that fails even after the pool's worker-death
-    resubmission is re-run once more from lineage here; only exhausted
+    The plan scheduler drives dispatch: map nodes go out with file
+    affinity (segment warmth — locality-aware placement), reduce nodes
+    dispatch only after the ``map`` stage barrier collects every map's
+    segment results on the scheduler's driver thread (never on a pool
+    dispatcher thread, which a blocking collect could deadlock). A map
+    task that fails even after the pool's worker-death resubmission is
+    re-run once more from lineage inside that barrier; only exhausted
     recovery propagates (thread-mode ``EpochLineage`` semantics).
+    Speculative backup attempts (``RSDL_PLAN_SPECULATION``) re-run the
+    same lineage payload on another worker; segment writes are atomic
+    and bit-identical, so first-completion-wins is safe.
     """
     import importlib
     sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
     from ray_shuffling_data_loader_tpu import stats as stats_mod
+    from ray_shuffling_data_loader_tpu.plan import (
+        scheduler as plan_scheduler)
 
+    epoch, seed = plan.epoch, plan.seed
+    num_reducers = plan.num_reducers
+    filenames = plan.filenames
     plan_threads = sh.derive_gather_threads(len(filenames),
                                             pool.num_workers)
 
@@ -956,75 +977,106 @@ def process_epoch(epoch: int,
                 f"e{epoch}_f{file_index}_table.arrow")
         return payload
 
-    map_refs = []
-    for file_index, filename in enumerate(filenames):
-        if stats_collector is not None:
-            stats_collector.map_start(epoch)
-        map_refs.append(pool.submit_kind(
-            "map", _map_payload(file_index, filename, True),
-            affinity=file_index))
-    ex.wait(map_refs, num_returns=len(map_refs))
-
+    holder: Dict[str, Any] = {}
     sources: List["tuple[str, str, bool]"] = []
     epoch_segs: List[str] = []  # epoch-scoped: unlinked at epoch drain
-    transient_bytes = 0
-    for file_index, (filename, ref) in enumerate(zip(filenames, map_refs)):
-        try:
-            res = ref.result()
-        except Exception as e:  # noqa: BLE001 - lineage re-run below
-            logger.warning(
-                "map task %d (epoch %d) failed on the pool (%s); "
-                "recomputing from lineage", file_index, epoch, e)
-            start = timeit.default_timer()
-            retry_ref = pool.submit_kind(
-                "map", _map_payload(file_index, filename, False),
-                affinity=file_index)
-            res = retry_ref.result()  # exhausted recovery propagates
-            stats_mod.fault_stats().record_recompute(
-                "lineage", timeit.default_timer() - start)
-        quarantined = res.get("quarantined")
-        if quarantined is not None:
-            stats_mod.fault_stats().record_quarantine(quarantined)
-            logger.error(
-                "quarantined unreadable input file %s (epoch %d, file %d): "
-                "%s (on_bad_file='skip')", filename, epoch, file_index,
-                quarantined.error)
-            if stats_collector is not None:
-                stats_collector.map_done(epoch, 0.0, 0.0)
-            continue
-        cached = bool(res.get("cached"))
-        if cached:
-            pool.note_table_seg(filename, res.get("table_seg"),
-                                res.get("wrote_table_bytes", 0))
-        else:
-            # Clears any unused cache grant (e.g. the granted attempt died
-            # and the lineage re-run published an epoch-scoped segment).
-            pool.note_table_seg(filename, None, 0)
-            epoch_segs.append(res["table_seg"])
-            transient_bytes += res.get("wrote_table_bytes", 0)
-        epoch_segs.append(res["idx_seg"])
-        transient_bytes += res.get("idx_bytes", 0)
-        sources.append((res["table_seg"], res["idx_seg"], cached))
-        if stats_collector is not None:
-            stats_collector.map_done(epoch, res["dur_s"], res["read_s"])
-        rt_telemetry.observe_stage("map_read", epoch=epoch, task=file_index,
-                                   dur_s=res["read_s"])
+    transient = {"bytes": 0, "buf_id": None}
 
-    from ray_shuffling_data_loader_tpu import native
-    ledger = native.buffer_ledger()
-    epoch_buf_id = ledger.register(transient_bytes) if transient_bytes \
-        else None
+    def _dispatch_map(node, attempt: int) -> ProcTaskRef:
+        file_index = node.key.task
+        payload = _map_payload(file_index, node.meta["file"],
+                               allow_cache_write=attempt == 0)
+        if attempt:
+            payload["attempt"] = attempt
+        elif stats_collector is not None:
+            stats_collector.map_start(epoch)
+        return pool.submit_kind("map", payload, affinity=file_index)
+
+    def _collect_maps() -> None:
+        """Map-stage barrier (scheduler driver thread): fold every map
+        node's segment reply into the reduce inputs, exactly the
+        bookkeeping the old await-then-submit loop did inline."""
+        from ray_shuffling_data_loader_tpu import native
+        scheduler = holder["scheduler"]
+        for node in sorted(plan.maps(), key=lambda n: n.key.task):
+            file_index = node.key.task
+            filename = node.meta["file"]
+            try:
+                res = scheduler.ref_for(node.id).result()
+            except Exception as e:  # noqa: BLE001 - lineage re-run below
+                logger.warning(
+                    "map task %d (epoch %d) failed on the pool (%s); "
+                    "recomputing from lineage", file_index, epoch, e)
+                start = timeit.default_timer()
+                retry_ref = pool.submit_kind(
+                    "map", _map_payload(file_index, filename, False),
+                    affinity=file_index)
+                res = retry_ref.result()  # exhausted recovery propagates
+                stats_mod.fault_stats().record_recompute(
+                    "lineage", timeit.default_timer() - start)
+            quarantined = res.get("quarantined")
+            if quarantined is not None:
+                stats_mod.fault_stats().record_quarantine(quarantined)
+                logger.error(
+                    "quarantined unreadable input file %s (epoch %d, "
+                    "file %d): %s (on_bad_file='skip')", filename, epoch,
+                    file_index, quarantined.error)
+                if stats_collector is not None:
+                    stats_collector.map_done(epoch, 0.0, 0.0)
+                continue
+            cached = bool(res.get("cached"))
+            if cached:
+                pool.note_table_seg(filename, res.get("table_seg"),
+                                    res.get("wrote_table_bytes", 0))
+            else:
+                # Clears any unused cache grant (e.g. the granted attempt
+                # died and the lineage re-run published an epoch-scoped
+                # segment).
+                pool.note_table_seg(filename, None, 0)
+                epoch_segs.append(res["table_seg"])
+                transient["bytes"] += res.get("wrote_table_bytes", 0)
+            epoch_segs.append(res["idx_seg"])
+            transient["bytes"] += res.get("idx_bytes", 0)
+            sources.append((res["table_seg"], res["idx_seg"], cached))
+            if stats_collector is not None:
+                stats_collector.map_done(epoch, res["dur_s"], res["read_s"])
+            rt_telemetry.observe_stage("map_read", epoch=epoch,
+                                       task=file_index,
+                                       dur_s=res["read_s"])
+        if transient["bytes"]:
+            transient["buf_id"] = native.buffer_ledger().register(
+                transient["bytes"])
+
+    def _dispatch_reduce(node, attempt: int) -> ProcTaskRef:
+        reduce_index = node.key.task
+        payload = {
+            "reduce_index": reduce_index,
+            "seed": seed,
+            "epoch": epoch,
+            "sources": sources,
+            "gather_threads": gather_threads,
+            "reduce_transform": reduce_transform_blob,
+            "out_seg": pool.segment_path(
+                f"e{epoch}_r{reduce_index}.arrow"),
+        }
+        if attempt:
+            payload["attempt"] = attempt
+        elif stats_collector is not None:
+            stats_collector.reduce_start(epoch)
+        return pool.submit_kind("reduce", payload)
+
     pending = {"reduces": num_reducers}
     cleanup_lock = threading.Lock()
 
     def _epoch_cleanup() -> None:
         # Last reduce reply consumed -> the epoch's plan segments (and any
         # uncached table segments) have no readers left.
+        from ray_shuffling_data_loader_tpu import native
         for path in epoch_segs:
             _unlink_quiet(path)
-        if epoch_buf_id is not None:
+        if transient["buf_id"] is not None:
             try:
-                ledger.decref(epoch_buf_id)
+                native.buffer_ledger().decref(transient["buf_id"])
             except KeyError:
                 pass
 
@@ -1050,21 +1102,12 @@ def process_epoch(epoch: int,
 
         return _finalize
 
-    reduce_refs = []
-    for reduce_index in range(num_reducers):
-        if stats_collector is not None:
-            stats_collector.reduce_start(epoch)
-        reduce_refs.append(pool.submit_kind(
-            "reduce",
-            {
-                "reduce_index": reduce_index,
-                "seed": seed,
-                "epoch": epoch,
-                "sources": sources,
-                "gather_threads": gather_threads,
-                "reduce_transform": reduce_transform_blob,
-                "out_seg": pool.segment_path(
-                    f"e{epoch}_r{reduce_index}.arrow"),
-            },
-            transform=_finalize_factory(reduce_index)))
-    return reduce_refs
+    scheduler = plan_scheduler.PlanScheduler(
+        plan, pool,
+        dispatchers={"map": _dispatch_map, "reduce": _dispatch_reduce},
+        barriers={"map": _collect_maps})
+    holder["scheduler"] = scheduler
+    scheduler.start()
+    return [ProcTaskRef(future, _finalize_factory(reduce_index))
+            for reduce_index, future in enumerate(
+                scheduler.futures("reduce"))]

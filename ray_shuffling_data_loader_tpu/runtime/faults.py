@@ -186,6 +186,29 @@ def parse_spec(spec: str) -> List[ChaosRule]:
     return [_parse_rule(part) for part in spec.split(",") if part.strip()]
 
 
+def spec_for_node(site: str, node, delay_ms: Optional[int] = None,
+                  count: int = 1) -> str:
+    """Chaos-rule text targeting one epoch-plan node (plan/ir.py).
+
+    The harness used to hand-write ``site:epochE:taskT`` rules from
+    privately re-derived key arithmetic; deriving the rule FROM the plan
+    node keeps the chaos key and the task's lineage key equal by
+    construction (they join in telemetry by ``(kind, epoch, task)``).
+    ``delay_ms`` builds a ``delayN`` straggler rule (the speculation
+    bench leg's injector) instead of a failure rule.
+    """
+    if site not in SITES:
+        raise ValueError(f"unknown chaos site {site!r} "
+                         f"(known: {sorted(SITES)})")
+    rule = f"{site}:epoch{node.key.epoch}:task{node.key.task}"
+    if delay_ms is not None:
+        rule += f":delay{int(delay_ms)}"
+    if count != 1:
+        rule += f":x{int(count)}"
+    _parse_rule(rule)  # validate the composed text loudly
+    return rule
+
+
 def _stable_draw(seed: int, site: str, epoch, task) -> float:
     """Deterministic uniform [0, 1) draw keyed by (seed, site, epoch,
     task) — the same seed reproduces the same failure set on any host."""

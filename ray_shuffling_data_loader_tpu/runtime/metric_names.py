@@ -48,6 +48,11 @@ METRIC_NAMES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "rsdl_executor_worker_up": ("gauge", ("pool", "pid")),
     "rsdl_pool_worker_restarts_total": ("counter", ("pool",)),
     "rsdl_worker_tasks_total": ("counter", ("worker",)),
+    # -- epoch-plan scheduler (plan/scheduler.py) --
+    "rsdl_plan_speculative_launched_total": ("counter", ("stage",)),
+    "rsdl_plan_speculative_won_total": ("counter", ("stage",)),
+    "rsdl_plan_speculative_wasted_total": ("counter", ("stage",)),
+    "rsdl_plan_steals_total": ("counter", ("stage",)),
     # -- queue service (multiqueue.py / multiqueue_service.py) --
     "rsdl_queue_depth": ("gauge", ("queue",)),
     "rsdl_queue_frames_replayed_total": ("counter", ()),

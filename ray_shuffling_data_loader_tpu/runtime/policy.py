@@ -185,6 +185,23 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     # (seed, epoch, file); the streams differ, so flipping this knob
     # mid-checkpoint changes the shuffle order.
     "partition_plan": ("fused", str),
+    # Epoch-plan scheduler (plan/scheduler.py). Speculative re-execution
+    # of stragglers: off by default (duplicate attempts are bit-identical
+    # by the lineage contract, but they absorb injected chaos faults and
+    # burn idle capacity, so racing them is an explicit operator choice —
+    # RSDL_PLAN_SPECULATION=1). A backup launches when a running task
+    # exceeds max(plan_speculation_min_s, multiplier x rolling per-stage
+    # median) and an idle lane exists; first completion wins.
+    "plan_speculation": (False, _parse_bool),
+    "plan_speculation_multiplier": (4.0, float),
+    "plan_speculation_min_s": (1.0, float),
+    # Straggler-check cadence of the plan driver thread (only paid while
+    # speculation is on; off, the driver blocks on completion events).
+    "plan_speculation_check_s": (0.05, float),
+    # Work-stealing placement: an idle lane pulls ready nodes from the
+    # longest sibling queue instead of waiting on its static round-robin
+    # assignment. On by default (outputs are placement-independent).
+    "plan_stealing": (True, _parse_bool),
     # What shuffle_map does with a corrupt/unreadable input file after
     # read retries are exhausted: "raise" (fail the map task; lineage
     # recovery then retries it, and only exhausted recovery poisons the

@@ -8,10 +8,12 @@ topology survive the loss of the **queue-server process itself**: a
 (kill -9, OOM, an injected ``queue_server_crash``) respawns it with
 bounded, jittered backoff. The restarted server
 (``multiqueue_service.serve_pipeline``) reloads the delivered-watermark
-journal (``checkpoint.WatermarkJournal``) and re-runs the deterministic
-shuffle lineage for the in-flight epoch, re-enqueueing only the
-undelivered remainder — consumers reconnect (their RetryPolicy redial)
-and resume exactly where their acks left off.
+journal (``checkpoint.WatermarkJournal``), asks the epoch plan where to
+resume (``plan.ir.resume_from_watermarks`` — the one home of the
+journal-resume math) and re-runs the deterministic shuffle lineage for
+the in-flight epoch, re-enqueueing only the undelivered remainder —
+consumers reconnect (their RetryPolicy redial) and resume exactly where
+their acks left off.
 
 Stdlib-only on purpose (the runtime/ contract): importable before
 jax/pyarrow; the child is spawned as

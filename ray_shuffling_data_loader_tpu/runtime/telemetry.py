@@ -431,6 +431,31 @@ def trace_seed() -> Optional[int]:
     return _trace_seed
 
 
+#: Thread-local marker set while a SPECULATIVE backup attempt runs
+#: (plan/scheduler.py first-completion-wins duplicates). Events recorded
+#: under it carry a ``spec`` attr and skip the attribution/histogram
+#: observation, so a duplicated attempt can never double-count a stage
+#: in trace merge or bottleneck attribution — the original attempt owns
+#: the canonical span for its lineage key.
+_speculative = threading.local()
+
+
+@contextlib.contextmanager
+def speculative(attempt: int = 1) -> Iterator[None]:
+    """Mark the enclosed work as a speculative duplicate attempt."""
+    prev = getattr(_speculative, "attempt", 0)
+    _speculative.attempt = attempt
+    try:
+        yield
+    finally:
+        _speculative.attempt = prev
+
+
+def speculative_attempt() -> int:
+    """The calling thread's active speculative attempt (0 = original)."""
+    return getattr(_speculative, "attempt", 0)
+
+
 def _record_impl(kind: str, epoch: Optional[int] = None,
                  task: Optional[int] = None, batch: Optional[int] = None,
                  dur_s: Optional[float] = None, t: Optional[float] = None,
@@ -449,9 +474,17 @@ def _record_impl(kind: str, epoch: Optional[int] = None,
         rec = recorder()
         if not _ENABLED:
             return
+    spec = getattr(_speculative, "attempt", 0)
+    if spec:
+        attrs = {**attrs, "spec": spec}
     now = time.monotonic() if t is None else t
     rec.record((now, kind, epoch, task, batch, dur_s,
                 threading.get_ident(), attrs or None))
+    if spec:
+        # Ring-only: the duplicate attempt is visible evidence (joined to
+        # the original by its lineage key) but must not double-count the
+        # stage in counters, histograms or bottleneck attribution.
+        return
     events_counter = _events_counter_cache.get(kind)
     if events_counter is None:
         events_counter = _events_counter_cache[kind] = metrics.counter(

@@ -216,10 +216,13 @@ def _normalize_in_process(events: Iterable[Dict[str, Any]], pid: int = 0
 
 
 def _spans(events: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
-    """Durational stage/link spans (the DAG's nodes)."""
+    """Durational stage/link spans (the DAG's nodes). Speculative
+    duplicate attempts (``spec`` attr, plan/scheduler.py) are excluded:
+    they share the original's lineage key by construction, and counting
+    both would double-bill the stage."""
     return [e for e in events
             if e.get("dur_s") and e.get("kind") in STAGE_RANK
-            and not e.get("fault")]
+            and not e.get("fault") and not e.get("spec")]
 
 
 def _epoch_windows(spans: Sequence[Dict[str, Any]]

@@ -1079,41 +1079,70 @@ def shuffle_epoch(epoch: int,
     """Launch one epoch's map/reduce and route outputs to trainers
     (reference: shuffle.py:163-196). Returns the reducer TaskRefs.
 
+    The epoch is executed as an explicit :class:`plan.ir.EpochPlan`
+    (files -> map partitions -> reduce slices -> queue routes) driven by
+    the plan scheduler (plan/scheduler.py): dependency-ordered dispatch
+    onto the pool, optional speculative re-execution of stragglers
+    (``RSDL_PLAN_SPECULATION``) and work-stealing placement
+    (``RSDL_PLAN_STEALING``) — on both executor backends.
+
     ``fault_policies`` carries the per-stage RetryPolicy objects built
     once by the driver (keys ``read``/``reduce``/``lineage``); when
     omitted they resolve from the runtime policy registry here — so a
     directly-driven epoch still recovers lost maps from lineage.
     """
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
     if stats_collector is not None:
         stats_collector.epoch_start(epoch)
+    plan = plan_ir.build_epoch_plan(filenames, num_reducers, num_trainers,
+                                    seed, epoch)
     if getattr(pool, "backend", "thread") == "process":
         reduce_refs = _shuffle_epoch_process(
-            epoch, filenames, num_reducers, pool, seed, stats_collector,
-            map_transform, reduce_transform, spill_manager, gather_threads,
-            on_bad_file)
-        for trainer_idx, batches in enumerate(
-                ops.contiguous_splits(reduce_refs, num_trainers)):
-            consume(trainer_idx, batch_consumer, trial_start,
-                    stats_collector, epoch, batches)
-            batch_consumer(trainer_idx, epoch, None)
-        return reduce_refs
+            plan, pool, stats_collector, map_transform, reduce_transform,
+            spill_manager, gather_threads, on_bad_file)
+    else:
+        reduce_refs = _shuffle_epoch_thread(
+            plan, pool, stats_collector, map_transform, file_cache,
+            reduce_transform, spill_manager, gather_threads, on_bad_file,
+            fault_policies)
+    # Queue routes come FROM the plan: each route node names its trainer
+    # rank, queue index and contiguous reducer span (the arithmetic the
+    # inline ops.contiguous_splits call used to re-derive).
+    for route in sorted(plan.routes(), key=lambda n: n.key.task):
+        rank = route.key.task
+        batches = [reduce_refs[i] for i in route.meta["reducers"]]
+        consume(rank, batch_consumer, trial_start, stats_collector,
+                epoch, batches)
+        # Epoch-end sentinel per trainer (reference: shuffle.py:195).
+        batch_consumer(rank, epoch, None)
+    return reduce_refs
+
+
+def _shuffle_epoch_thread(plan, pool, stats_collector, map_transform,
+                          file_cache, reduce_transform, spill_manager,
+                          gather_threads, on_bad_file, fault_policies
+                          ) -> List[ex.TaskRef]:
+    """Thread-backend epoch engine: the plan's map/reduce nodes dispatch
+    onto the thread pool in dependency order. Reduce tasks keep their
+    :class:`EpochLineage` recovery (a failed map ref is recomputed inline
+    by the first reduce that observes it — recompute counts and failure
+    semantics are unchanged by the plan engine underneath). Speculative
+    backup attempts run under ``telemetry.speculative()`` with no stats
+    collector, so duplicated work never double-counts anywhere."""
+    from ray_shuffling_data_loader_tpu.plan import scheduler as plan_sched
+    epoch, seed = plan.epoch, plan.seed
+    num_reducers = plan.num_reducers
+    filenames_list = list(plan.filenames)
     policies = fault_policies if fault_policies is not None \
         else default_fault_policies()
-    map_refs = [
-        pool.submit(shuffle_map, filename, num_reducers, seed, epoch,
-                    file_index, stats_collector, map_transform, file_cache,
-                    on_bad_file, policies.get("read"))
-        for file_index, filename in enumerate(filenames)
-    ]
     if gather_threads is None:
         gather_threads = derive_gather_threads(num_reducers,
                                                pool.num_workers)
-    lineage = EpochLineage(filenames, num_reducers, seed, epoch,
+    lineage = EpochLineage(filenames_list, num_reducers, seed, epoch,
                            stats_collector, map_transform, file_cache,
                            retry_policy=policies.get("lineage"),
                            on_bad_file=on_bad_file,
                            read_retry=policies.get("read"))
-    filenames_list = list(filenames)
 
     def _spill_recompute_for(reduce_index: int):
         if spill_manager is None:
@@ -1123,34 +1152,63 @@ def shuffle_epoch(epoch: int,
             epoch, reduce_index, map_transform, reduce_transform,
             on_bad_file)
 
-    reduce_refs = [
-        pool.submit(_reduce_task, reduce_index, seed, epoch, map_refs,
-                    stats_collector, reduce_transform, spill_manager,
-                    gather_threads, lineage, policies.get("reduce"),
-                    _spill_recompute_for(reduce_index))
-        for reduce_index in range(num_reducers)
-    ]
-    for trainer_idx, batches in enumerate(
-            ops.contiguous_splits(reduce_refs, num_trainers)):
-        consume(trainer_idx, batch_consumer, trial_start, stats_collector,
-                epoch, batches)
-        # Epoch-end sentinel per trainer (reference: shuffle.py:195).
-        batch_consumer(trainer_idx, epoch, None)
-    return reduce_refs
+    holder: Dict[str, Any] = {}
+
+    def _run_map(node, attempt: int):
+        file_index = node.key.task
+        if attempt == 0:
+            return shuffle_map(node.meta["file"], num_reducers, seed,
+                               epoch, file_index, stats_collector,
+                               map_transform, file_cache, on_bad_file,
+                               policies.get("read"))
+        with rt_telemetry.speculative(attempt):
+            return shuffle_map(node.meta["file"], num_reducers, seed,
+                               epoch, file_index, None, map_transform,
+                               file_cache, on_bad_file,
+                               policies.get("read"))
+
+    def _run_reduce(node, attempt: int):
+        reduce_index = node.key.task
+        map_refs = [holder["scheduler"].ref_for(dep) for dep in node.deps]
+        if attempt == 0:
+            return _reduce_task(reduce_index, seed, epoch, map_refs,
+                                stats_collector, reduce_transform,
+                                spill_manager, gather_threads, lineage,
+                                policies.get("reduce"),
+                                _spill_recompute_for(reduce_index))
+        with rt_telemetry.speculative(attempt):
+            return _reduce_task(reduce_index, seed, epoch, map_refs,
+                                None, reduce_transform, spill_manager,
+                                gather_threads, lineage,
+                                policies.get("reduce"),
+                                _spill_recompute_for(reduce_index))
+
+    scheduler = plan_sched.PlanScheduler(
+        plan, pool,
+        dispatchers={
+            "map": lambda node, attempt: pool.submit(_run_map, node,
+                                                     attempt),
+            "reduce": lambda node, attempt: pool.submit(_run_reduce, node,
+                                                        attempt),
+        })
+    holder["scheduler"] = scheduler
+    scheduler.start()
+    return scheduler.refs("reduce")
 
 
-def _shuffle_epoch_process(epoch, filenames, num_reducers, pool, seed,
-                           stats_collector, map_transform,
+def _shuffle_epoch_process(plan, pool, stats_collector, map_transform,
                            reduce_transform, spill_manager, gather_threads,
                            on_bad_file):
-    """Process-backend epoch launch: delegate to the pool's data plane
-    (procpool.process_epoch) with the workload hooks pickled once. The
-    spill-recompute lineage closure is driver-side (identical to the
+    """Process-backend epoch launch: delegate the PLAN to the pool's data
+    plane (procpool.process_epoch) with the workload hooks pickled once.
+    The spill-recompute lineage closure is driver-side (identical to the
     thread path), so a corrupt spilled segment recovers the same way on
     either backend."""
     import pickle as _pickle
     from ray_shuffling_data_loader_tpu import procpool
-    filenames_list = list(filenames)
+    epoch, seed = plan.epoch, plan.seed
+    num_reducers = plan.num_reducers
+    filenames_list = list(plan.filenames)
     if gather_threads is None:
         gather_threads = derive_gather_threads(num_reducers,
                                                pool.num_workers)
@@ -1162,7 +1220,7 @@ def _shuffle_epoch_process(epoch, filenames, num_reducers, pool, seed,
             on_bad_file)
 
     return procpool.process_epoch(
-        epoch, filenames_list, num_reducers, pool, seed, stats_collector,
+        plan, pool, stats_collector,
         _pickle.dumps(map_transform) if map_transform is not None else None,
         _pickle.dumps(reduce_transform)
         if reduce_transform is not None else None,

@@ -420,6 +420,35 @@ UNREGISTERED_METRIC_OK = """
         rt_metrics.get(name)
 """
 
+LINEAGE_PLAN_ROUTE_BAD = """
+    def route(epoch, rank, num_trainers):
+        return epoch * num_trainers + rank
+"""
+
+LINEAGE_PLAN_INVERSE_BAD = """
+    class Server:
+        def epoch_of(self, queue_idx):
+            return queue_idx // self._num_trainers
+"""
+
+LINEAGE_PLAN_SEEDSEQ_BAD = """
+    import numpy as np
+
+    def my_rng(seed, epoch, task):
+        seq = np.random.SeedSequence(entropy=seed,
+                                     spawn_key=(epoch, task))
+        return np.random.Generator(np.random.Philox(seq))
+"""
+
+LINEAGE_PLAN_OK = """
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+
+    def route(epoch, rank, num_trainers):
+        # plan queries, and non-route arithmetic, both pass
+        host = rank // 4
+        return plan_ir.queue_index(epoch, rank, num_trainers), host
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -444,7 +473,26 @@ CASES = [
      {"path": "pkg/shuffle.py"}),
     ("unregistered-metric", UNREGISTERED_METRIC_BAD, UNREGISTERED_METRIC_OK,
      {"path": "ray_shuffling_data_loader_tpu/multiqueue.py"}),
+    ("lineage-outside-plan", LINEAGE_PLAN_ROUTE_BAD, LINEAGE_PLAN_OK,
+     {"path": "ray_shuffling_data_loader_tpu/dataset.py"}),
+    ("lineage-outside-plan", LINEAGE_PLAN_INVERSE_BAD, LINEAGE_PLAN_OK,
+     {"path": "ray_shuffling_data_loader_tpu/multiqueue_service.py"}),
+    ("lineage-outside-plan", LINEAGE_PLAN_SEEDSEQ_BAD, LINEAGE_PLAN_OK,
+     {"path": "ray_shuffling_data_loader_tpu/workers.py"}),
 ]
+
+
+def test_lineage_outside_plan_scoped_to_library_code():
+    """plan/ and ops/partition.py are the blessed homes of the key
+    arithmetic; tests and tools re-derive freely."""
+    for exempt in ("ray_shuffling_data_loader_tpu/plan/ir.py",
+                   "ray_shuffling_data_loader_tpu/ops/partition.py",
+                   "tests/test_x.py", "tools/rsdl_plan.py"):
+        flagged, _ = lint(LINEAGE_PLAN_ROUTE_BAD, path=exempt)
+        assert "lineage-outside-plan" not in flagged, exempt
+    flagged, _ = lint(LINEAGE_PLAN_ROUTE_BAD,
+                      path="ray_shuffling_data_loader_tpu/dataset.py")
+    assert "lineage-outside-plan" in flagged
 
 
 def test_unregistered_metric_scoped_to_library_code():

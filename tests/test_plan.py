@@ -1,0 +1,398 @@
+"""Tests for the epoch-plan subsystem (plan/ir.py, plan/scheduler.py):
+IR round-trip + validation, plan queries vs. their historical private
+arithmetic, scheduler dependency order, speculative first-completion-
+wins bit-identity on both executor backends, steal-vs-static placement
+equivalence, and plan-backed resume math equal to the PR 5 answers."""
+
+import collections
+import importlib
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+from ray_shuffling_data_loader_tpu import executor as ex
+from ray_shuffling_data_loader_tpu import procpool
+from ray_shuffling_data_loader_tpu.ops import partition as ops
+from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+from ray_shuffling_data_loader_tpu.plan import scheduler as plan_sched
+from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
+
+sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
+svc = importlib.import_module(
+    "ray_shuffling_data_loader_tpu.multiqueue_service")
+
+
+def write_files(tmp_path, num_files=3, rows_per_file=60):
+    filenames = []
+    for i in range(num_files):
+        start = i * rows_per_file
+        table = pa.table({
+            "key": pa.array(range(start, start + rows_per_file),
+                            type=pa.int64()),
+            "value": pa.array(np.arange(start, start + rows_per_file,
+                                        dtype=np.float64)),
+        })
+        path = str(tmp_path / f"input_{i}.parquet")
+        pq.write_table(table, path)
+        filenames.append(path)
+    return filenames
+
+
+class CollectingConsumer:
+    def __init__(self):
+        self.tables = collections.defaultdict(list)
+        self.lock = threading.Lock()
+
+    def __call__(self, rank, epoch, refs):
+        if refs is None:
+            return
+        tables = [ref.result() for ref in refs]
+        with self.lock:
+            self.tables[(rank, epoch)].extend(tables)
+
+    def stream(self, epoch, num_trainers):
+        out = []
+        for rank in range(num_trainers):
+            for table in self.tables[(rank, epoch)]:
+                out.extend(table.column("key").to_pylist())
+        return out
+
+
+# ---------------------------------------------------------------------------
+# IR: build / validate / round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_build_plan_shape_and_queries():
+    plan = plan_ir.build_epoch_plan(["a", "b", "c"], num_reducers=4,
+                                    num_trainers=2, seed=5, epoch=2)
+    assert len(plan.maps()) == 3
+    assert len(plan.reduces()) == 4
+    assert len(plan.routes()) == 2
+    assert plan.map_key(1) == plan_ir.LineageKey(5, 2, 1)
+    assert plan.reduce_key(3).as_tuple() == (5, 2, 3)
+    for node in plan.reduces():
+        assert set(node.deps) == {n.id for n in plan.maps()}
+    route0, route1 = sorted(plan.routes(), key=lambda n: n.key.task)
+    assert route0.meta["reducers"] == [0, 1]
+    assert route1.meta["reducers"] == [2, 3]
+    assert route0.meta["queue"] == plan_ir.queue_index(2, 0, 2)
+
+
+def test_json_round_trip_is_byte_stable():
+    plan = plan_ir.build_epoch_plan(["x.parquet", "y.parquet"], 3, 2,
+                                    seed=9, epoch=1)
+    plan.annotate_costs({"map": 0.01, "reduce": 0.02})
+    text = plan.to_json()
+    again = plan_ir.from_json(text)
+    again.validate()
+    assert again.to_json() == text
+    assert again.node("map:e1:t0").cost_s == pytest.approx(0.01)
+    assert again.node("reduce:e1:t2").cost_s == pytest.approx(0.02)
+
+
+@pytest.mark.parametrize("mutate, match", [
+    (lambda d: d["nodes"][0]["key"].__setitem__(0, 99), "disagrees"),
+    (lambda d: d["nodes"].append(dict(d["nodes"][0])), "duplicate"),
+    (lambda d: d["nodes"][-1]["meta"].__setitem__("reducers", [0]),
+     "reducer range|deps do not match"),
+    (lambda d: d["nodes"][2]["deps"].pop(), "must depend on every map"),
+])
+def test_validation_rejects_malformed_plans(mutate, match):
+    import json
+    plan = plan_ir.build_epoch_plan(["a", "b"], 2, 1, seed=0, epoch=0)
+    data = json.loads(plan.to_json())
+    mutate(data)
+    with pytest.raises(plan_ir.PlanError, match=match):
+        plan = plan_ir.EpochPlan.from_dict(data)
+        plan.validate()
+
+
+def test_route_slices_match_ops_contiguous_splits():
+    """plan/ir.py mirrors ops.partition's remainder-first arithmetic so
+    it can stay stdlib-only; equality is the contract."""
+    for total, parts in [(7, 3), (4, 4), (2, 5), (12, 5), (0, 2)]:
+        want = ops.contiguous_splits(list(range(total)), parts)
+        got = [list(range(a, b))
+               for a, b in plan_ir.route_slices(total, parts)]
+        assert got == want, (total, parts)
+
+
+def test_queue_index_inverses():
+    for epoch in range(3):
+        for rank in range(4):
+            q = plan_ir.queue_index(epoch, rank, 4)
+            assert plan_ir.queue_epoch(q, 4) == epoch
+            assert plan_ir.queue_rank(q, 4) == rank
+
+
+# ---------------------------------------------------------------------------
+# Plan-backed resume math == the PR 5 answers
+# ---------------------------------------------------------------------------
+
+
+def test_resume_from_watermarks_matches_pr5_fixture():
+    state = {
+        0: ckpt.WatermarkEntry(seq=4, rows=500, done=True),
+        1: ckpt.WatermarkEntry(seq=4, rows=500, done=True),
+        2: ckpt.WatermarkEntry(seq=1, rows=200, done=False),
+    }
+    assert plan_ir.resume_from_watermarks(state, 3, 2) == (1, {2: 2})
+    # The service wrapper is the same math (delegation, not a copy).
+    assert svc._resume_plan(state, 3, 2) == (1, {2: 2})
+    # Dict-shaped entries (a journal slice parsed by a tool) work too.
+    as_dicts = {q: {"seq": e.seq, "done": e.done}
+                for q, e in state.items()}
+    assert plan_ir.resume_from_watermarks(as_dicts, 3, 2) == (1, {2: 2})
+
+
+def test_watermark_journal_resume_plan_helper(tmp_path):
+    path = str(tmp_path / "wm.jsonl")
+    journal = ckpt.WatermarkJournal(path)
+    journal.record(0, seq=4, rows=500, done=True)
+    journal.record(1, seq=4, rows=500, done=True)
+    journal.record(2, seq=1, rows=200, done=False)
+    journal.close()
+    assert ckpt.WatermarkJournal(path).resume_plan(3, 2) == (1, {2: 2})
+
+
+# ---------------------------------------------------------------------------
+# Scheduler: dependency order, stealing, speculation
+# ---------------------------------------------------------------------------
+
+
+def _record_dispatchers(pool, order, lock, reduce_sleep=0.0):
+    def run(node, attempt):
+        with lock:
+            order.append((node.stage, node.key.task, attempt))
+        if node.stage == "reduce" and reduce_sleep:
+            time.sleep(reduce_sleep)
+        return node.id
+
+    return {
+        "map": lambda node, attempt: pool.submit(run, node, attempt),
+        "reduce": lambda node, attempt: pool.submit(run, node, attempt),
+    }
+
+
+def test_scheduler_dispatches_in_dependency_order():
+    plan = plan_ir.build_epoch_plan([f"f{i}" for i in range(4)], 3, 1,
+                                    seed=0, epoch=0)
+    order = []
+    lock = threading.Lock()
+    pool = ex.Executor(num_workers=2, thread_name_prefix="plan-test")
+    try:
+        sched = plan_sched.PlanScheduler(
+            plan, pool, _record_dispatchers(pool, order, lock)).start()
+        refs = sched.refs("reduce")
+        assert [r.result(timeout=30) for r in refs] == [
+            "reduce:e0:t0", "reduce:e0:t1", "reduce:e0:t2"]
+        assert sched.join(timeout=30)
+    finally:
+        pool.shutdown()
+    first_reduce = min(i for i, (stage, _, _) in enumerate(order)
+                       if stage == "reduce")
+    map_positions = [i for i, (stage, _, _) in enumerate(order)
+                     if stage == "map"]
+    assert max(map_positions) < first_reduce  # no reduce before all maps
+
+
+def test_scheduler_propagates_dispatch_and_task_failures():
+    plan = plan_ir.build_epoch_plan(["f0"], 1, 1, seed=0, epoch=0)
+    pool = ex.Executor(num_workers=1, thread_name_prefix="plan-test")
+
+    def boom(node, attempt):
+        raise RuntimeError("task body failed")
+
+    try:
+        sched = plan_sched.PlanScheduler(plan, pool, {
+            "map": lambda n, a: pool.submit(boom, n, a),
+            "reduce": lambda n, a: pool.submit(lambda: "r"),
+        }).start()
+        with pytest.raises(RuntimeError, match="task body failed"):
+            sched.refs("map")[0].result(timeout=30)
+        # Failed deps still release dependents (lineage semantics).
+        assert sched.refs("reduce")[0].result(timeout=30) == "r"
+    finally:
+        pool.shutdown()
+
+
+def test_stealing_pulls_from_loaded_lane_and_counts():
+    """Lane 1's first task is slow, so its second queued task (t3) is
+    exactly the straggler-behind-a-straggler static placement parks:
+    with stealing on, the idle lane 0 must pull it and count the steal;
+    with stealing off, placement stays static (no steal) — results
+    identical either way."""
+    for stealing, expect_steal in ((True, True), (False, False)):
+        plan = plan_ir.build_epoch_plan([f"f{i}" for i in range(4)], 1, 1,
+                                        seed=0, epoch=0)
+        before = plan_sched.speculation_totals()["steals"]
+        pool = ex.Executor(num_workers=2, thread_name_prefix="plan-test")
+        try:
+            def run(node, attempt):
+                # t1 (lane 1) is slow; t3 queues behind it on lane 1.
+                time.sleep(0.4 if node.key.task == 1 else 0.01)
+                return node.key.task
+
+            sched = plan_sched.PlanScheduler(
+                plan, pool,
+                {"map": lambda n, a: pool.submit(run, n, a),
+                 "reduce": lambda n, a: pool.submit(lambda: "r")},
+                policy=plan_sched.SchedulerPolicy(speculation=False,
+                                                  stealing=stealing),
+                lanes=2).start()
+            assert [r.result(timeout=30)
+                    for r in sched.refs("map")] == [0, 1, 2, 3]
+            assert sched.join(timeout=30)
+        finally:
+            pool.shutdown()
+        stolen = plan_sched.speculation_totals()["steals"] - before
+        if expect_steal:
+            assert stolen >= 1
+        else:
+            assert stolen == 0
+
+
+def test_speculation_backs_up_straggler_first_wins():
+    """A task an order of magnitude slower than its stage median gets a
+    backup; the backup (not delayed) wins; both results are identical so
+    the winner is indistinguishable — and the totals record the race."""
+    plan = plan_ir.build_epoch_plan([f"f{i}" for i in range(6)], 1, 1,
+                                    seed=0, epoch=0)
+    slow_once = {"armed": True}
+    lock = threading.Lock()
+
+    def run(node, attempt):
+        if node.key.task == 5 and attempt == 0:
+            with lock:
+                arm = slow_once["armed"]
+                slow_once["armed"] = False
+            if arm:
+                time.sleep(1.5)
+        return ("map", node.key.task)
+
+    before = plan_sched.speculation_totals()
+    pool = ex.Executor(num_workers=3, thread_name_prefix="plan-test")
+    try:
+        sched = plan_sched.PlanScheduler(
+            plan, pool,
+            {"map": lambda n, a: pool.submit(run, n, a),
+             "reduce": lambda n, a: pool.submit(lambda: "r")},
+            policy=plan_sched.SchedulerPolicy(
+                speculation=True, multiplier=3.0, min_task_s=0.2,
+                check_interval_s=0.02)).start()
+        results = [r.result(timeout=60) for r in sched.refs("map")]
+        assert results == [("map", t) for t in range(6)]
+        assert sched.join(timeout=60)
+    finally:
+        pool.shutdown()
+    after = plan_sched.speculation_totals()
+    assert after["speculative_launched"] - \
+        before["speculative_launched"] >= 1
+    assert after["speculative_won"] - before["speculative_won"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: plan-backed shuffle, speculation + stealing bit-identity
+# ---------------------------------------------------------------------------
+
+
+def _run_shuffle(filenames, monkeypatch, num_workers=4, **env):
+    for key in ("RSDL_PLAN_SPECULATION", "RSDL_PLAN_STEALING",
+                "RSDL_PLAN_SPECULATION_MIN_S",
+                "RSDL_PLAN_SPECULATION_MULTIPLIER"):
+        monkeypatch.delenv(key, raising=False)
+    for key, value in env.items():
+        monkeypatch.setenv(key, value)
+    consumer = CollectingConsumer()
+    sh.shuffle(filenames, consumer, num_epochs=2, num_reducers=3,
+               num_trainers=2, seed=11, num_workers=num_workers,
+               collect_stats=False, executor_backend="thread")
+    return [consumer.stream(e, 2) for e in range(2)]
+
+
+def test_thread_shuffle_bit_identical_across_placement_modes(
+        tmp_path, monkeypatch):
+    filenames = write_files(tmp_path)
+    base = _run_shuffle(filenames, monkeypatch, RSDL_PLAN_STEALING="1")
+    static = _run_shuffle(filenames, monkeypatch, RSDL_PLAN_STEALING="0")
+    assert base == static
+
+
+def test_thread_speculation_with_chaos_straggler_bit_identical(
+        tmp_path, monkeypatch):
+    """An injected delayN straggler (chaos fires once per lineage key)
+    races its backup; the consumed stream is bit-identical to the
+    speculation-off run and a backup actually won."""
+    filenames = write_files(tmp_path)
+    plan = plan_ir.build_epoch_plan(filenames, 3, 2, seed=11, epoch=0)
+    rule = rt_faults.spec_for_node("reduce_gather", plan.reduces()[1],
+                                   delay_ms=1200)
+    assert rule == "reduce_gather:epoch0:task1:delay1200"
+
+    baseline = _run_shuffle(filenames, monkeypatch)
+    before = plan_sched.speculation_totals()
+    rt_faults.install(rule, seed=3)
+    try:
+        raced = _run_shuffle(
+            filenames, monkeypatch,
+            RSDL_PLAN_SPECULATION="1",
+            RSDL_PLAN_SPECULATION_MIN_S="0.3",
+            RSDL_PLAN_SPECULATION_MULTIPLIER="2.0")
+    finally:
+        rt_faults.clear()
+    after = plan_sched.speculation_totals()
+    assert raced == baseline
+    assert after["speculative_launched"] - \
+        before["speculative_launched"] >= 1
+    assert after["speculative_won"] - before["speculative_won"] >= 1
+
+
+def test_process_backend_speculation_bit_identical(tmp_path, monkeypatch):
+    """Process-pool equivalent of the bench straggler leg (the 1-CPU
+    bench host runs that leg on the thread backend; the process-backend
+    contract is pinned here): force an aggressive speculation policy so
+    backups race ordinary tasks, and assert the consumed stream is
+    bit-identical to the thread backend's."""
+    if not procpool.shm_available():
+        pytest.skip("no writable shm/temp dir")
+    filenames = write_files(tmp_path, num_files=2, rows_per_file=40)
+    thread_streams = _run_shuffle(filenames, monkeypatch, num_workers=2)
+
+    for key, value in (("RSDL_PLAN_SPECULATION", "1"),
+                       ("RSDL_PLAN_SPECULATION_MIN_S", "0.0"),
+                       ("RSDL_PLAN_SPECULATION_MULTIPLIER", "0.0"),
+                       ("RSDL_PLAN_SPECULATION_CHECK_S", "0.01")):
+        monkeypatch.setenv(key, value)
+    before = plan_sched.speculation_totals()
+    consumer = CollectingConsumer()
+    sh.shuffle(filenames, consumer, num_epochs=2, num_reducers=3,
+               num_trainers=2, seed=11, num_workers=2,
+               collect_stats=False, executor_backend="process")
+    after = plan_sched.speculation_totals()
+    process_streams = [consumer.stream(e, 2) for e in range(2)]
+    assert process_streams == thread_streams
+    assert after["speculative_launched"] - \
+        before["speculative_launched"] >= 1
+
+
+def test_speculative_events_carry_spec_attr_and_skip_attribution():
+    rt_telemetry.configure(enabled_flag=True)
+    rec = rt_telemetry.recorder()
+    with rt_telemetry.speculative(1):
+        rt_telemetry.record("reduce_gather", epoch=0, task=3, dur_s=0.5)
+    events = [e for e in rec.events()
+              if e.get("kind") == "reduce_gather" and e.get("task") == 3
+              and e.get("spec")]
+    assert events and events[-1]["spec"] == 1
+    # trace.py drops spec spans from the DAG so the stage is not
+    # double-billed.
+    from ray_shuffling_data_loader_tpu.runtime import trace as rt_trace
+    spans = rt_trace._spans(rt_trace._normalize_in_process(events))
+    assert spans == []

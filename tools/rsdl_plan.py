@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""rsdl-plan: render and validate serialized epoch plans (plan/ir.py).
+
+The epoch plan is the pipeline's declarative task graph — files -> map
+partitions -> reduce slices -> queue routes, every node carrying its
+``(seed, epoch, task)`` lineage key, dependency edges and telemetry-fed
+cost annotations. This tool is the operator's window into one:
+
+Usage::
+
+    tools/rsdl_plan.py render plan.json            # node/edge table
+    tools/rsdl_plan.py render plan.json --json     # normalized JSON dump
+    tools/rsdl_plan.py validate plan.json          # rc 0 valid / 1 not
+    tools/rsdl_plan.py --check                     # self-test (format.sh)
+
+``validate`` exits 0 when the file deserializes AND passes the IR's
+structural validation (key consistency, closed acyclic deps, reduce
+coverage, contiguous route coverage); 1 otherwise, with the reason on
+stderr. ``--check`` builds a small demo plan in memory, round-trips it
+through JSON, and validates — the informational self-test format.sh
+runs.
+
+Stdlib-only: ``plan/ir.py`` is loaded straight by file path, so this
+tool runs on hosts without numpy/pyarrow/jax installed.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_IR_PATH = os.path.join(_REPO_ROOT, "ray_shuffling_data_loader_tpu",
+                        "plan", "ir.py")
+
+
+def _load_ir_module():
+    """Load plan/ir.py WITHOUT importing the package (whose __init__
+    pulls numpy/pyarrow); ir.py itself is stdlib-only."""
+    spec = importlib.util.spec_from_file_location("rsdl_plan_ir", _IR_PATH)
+    module = importlib.util.module_from_spec(spec)
+    # Registered before exec: ir.py's dataclasses resolve their module
+    # through sys.modules at class-creation time.
+    sys.modules[spec.name] = module
+    spec.loader.exec_module(module)
+    return module
+
+
+def _load_plan(ir, path: str):
+    with open(path, encoding="utf-8") as f:
+        return ir.from_json(f.read())
+
+
+def _fmt_cost(cost) -> str:
+    return "-" if cost is None else f"{cost * 1e3:.1f}ms"
+
+
+def render(ir, plan, as_json: bool) -> int:
+    if as_json:
+        print(plan.to_json(indent=2))
+        return 0
+    print(f"epoch plan: seed={plan.seed} epoch={plan.epoch} "
+          f"files={len(plan.filenames)} reducers={plan.num_reducers} "
+          f"trainers={plan.num_trainers} nodes={len(plan.nodes)}")
+    header = f"{'node':<16} {'lineage':<12} {'cost':>8}  deps / meta"
+    print(header)
+    print("-" * len(header))
+    for node in plan.nodes.values():
+        if node.stage == "map":
+            detail = node.meta.get("file", "")
+        elif node.stage == "reduce":
+            deps = list(node.deps)
+            detail = (f"<- {deps[0]} .. {deps[-1]} ({len(deps)} maps)"
+                      if deps else "<- (no maps)")
+        else:
+            span = node.meta.get("reducers", [])
+            span_text = (f"reducers {span[0]}..{span[-1]}" if span
+                         else "reducers (none)")
+            detail = f"queue {node.meta.get('queue')} <- {span_text}"
+        print(f"{node.id:<16} {str(node.key):<12} "
+              f"{_fmt_cost(node.cost_s):>8}  {detail}")
+    return 0
+
+
+def validate(ir, path: str) -> int:
+    try:
+        plan = _load_plan(ir, path)
+        plan.validate()
+    except (OSError, ir.PlanError) as e:
+        print(f"rsdl-plan: INVALID {path}: {e}", file=sys.stderr)
+        return 1
+    print(f"rsdl-plan: OK {path} ({len(plan.nodes)} nodes, "
+          f"seed={plan.seed}, epoch={plan.epoch})")
+    return 0
+
+
+def check(ir) -> int:
+    """Self-test: build -> serialize -> parse -> validate -> re-serialize
+    byte-identically; exercise one malformed-plan rejection."""
+    plan = ir.build_epoch_plan(["a.parquet", "b.parquet", "c.parquet"],
+                               num_reducers=4, num_trainers=2, seed=7,
+                               epoch=3)
+    plan.annotate_costs({"map": 0.012, "reduce": 0.034})
+    text = plan.to_json()
+    again = ir.from_json(text)
+    again.validate()
+    if again.to_json() != text:
+        print("rsdl-plan: FAIL round-trip not byte-stable",
+              file=sys.stderr)
+        return 1
+    broken = json.loads(text)
+    broken["nodes"][0]["key"] = [99, 99, 99]
+    try:
+        ir.EpochPlan.from_dict(broken).validate()
+    except ir.PlanError:
+        pass
+    else:
+        print("rsdl-plan: FAIL validation accepted a corrupt lineage key",
+              file=sys.stderr)
+        return 1
+    print(f"rsdl-plan: check OK ({len(plan.nodes)} nodes round-tripped, "
+          "corrupt key rejected)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="rsdl-plan",
+        description="Render / validate serialized epoch plans.")
+    parser.add_argument("command", nargs="?",
+                        choices=("render", "validate"),
+                        help="what to do with the plan file")
+    parser.add_argument("plan", nargs="?", help="serialized plan JSON")
+    parser.add_argument("--json", action="store_true",
+                        help="render as normalized JSON")
+    parser.add_argument("--check", action="store_true",
+                        help="run the built-in self-test and exit")
+    args = parser.parse_args(argv)
+    ir = _load_ir_module()
+    if args.check:
+        return check(ir)
+    if not args.command or not args.plan:
+        parser.error("command and plan file required (or --check)")
+    if args.command == "validate":
+        return validate(ir, args.plan)
+    try:
+        plan = _load_plan(ir, args.plan)
+    except (OSError, ir.PlanError) as e:
+        print(f"rsdl-plan: cannot load {args.plan}: {e}", file=sys.stderr)
+        return 1
+    return render(ir, plan, args.json)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
