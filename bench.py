@@ -1076,6 +1076,125 @@ def _run_speculation_leg(seed: int) -> dict:
     return result
 
 
+def _run_serve_leg(filenames, seed: int = 0,
+                   trainer_streams: int = 8,
+                   shards: int = 4) -> dict:
+    """Serving-plane leg: ``trainer_streams`` concurrent remote trainer
+    streams draining one pre-shuffled epoch through the sharded queue
+    fabric, measured three ways on the same seed —
+
+    1. single shard, shm-handle delivery (the pre-PR-10 topology's
+       process count with the new wire),
+    2. ``shards`` shards, shm-handle delivery (the headline
+       ``serve_rows_per_sec``; the ratio vs leg 1 is the shard-scaling
+       evidence), and
+    3. ``shards`` shards, streamed v2 delivery (same table flow, so the
+       wire-byte ratio vs leg 2 attributes the handle win per layer,
+       not by inference).
+
+    The shuffle runs to completion BEFORE each clock starts: the leg
+    times the serving plane, not the producer. Byte/handle/compression
+    counters are attributed per leg by delta
+    (``stats.queue_serve_totals``).
+    """
+    import threading
+
+    from ray_shuffling_data_loader_tpu import multiqueue as mq
+    from ray_shuffling_data_loader_tpu import multiqueue_service as svc
+    from ray_shuffling_data_loader_tpu import stats as rsdl_stats
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+    from ray_shuffling_data_loader_tpu.shuffle import shuffle as run_shuffle
+
+    # A small sub-corpus: the leg measures the serving plane's relative
+    # scaling/wire behavior, not corpus throughput.
+    leg_files = filenames[:2]
+    num_reducers = trainer_streams
+
+    def _fill_queue():
+        queue = mq.MultiQueue(trainer_streams)
+
+        def consumer(rank, epoch, refs):
+            queue_idx = plan_ir.queue_index(epoch, rank, trainer_streams)
+            if refs is None:
+                queue.put(queue_idx, None)
+            else:
+                queue.put_batch(queue_idx, list(refs))
+
+        run_shuffle(leg_files, consumer, 1, num_reducers=num_reducers,
+                    num_trainers=trainer_streams, max_concurrent_epochs=1,
+                    seed=seed, collect_stats=False, file_cache=None)
+        return queue
+
+    def _drain(num_shards: int, delivery: str) -> "tuple[float, dict]":
+        queue = _fill_queue()
+        counts = [0] * trainer_streams
+        errors: list = []
+        before = rsdl_stats.queue_serve_totals()
+        with svc.serve_queue_sharded(queue, num_shards=num_shards,
+                                     num_trainers=trainer_streams
+                                     ) as sharded:
+
+            def consume(rank: int) -> None:
+                try:
+                    with svc.ShardedRemoteQueue(
+                            sharded.shard_map, max_batch=4,
+                            delivery=delivery) as remote:
+                        queue_idx = plan_ir.queue_index(
+                            0, rank, trainer_streams)
+                        while True:
+                            table = remote.get(queue_idx)
+                            if table is None:
+                                return
+                            counts[rank] += table.num_rows
+                except BaseException as e:  # noqa: BLE001 - re-raised
+                    errors.append(e)
+
+            threads = [threading.Thread(target=consume, args=(r,),
+                                        daemon=True,
+                                        name=f"bench-serve-{r}")
+                       for r in range(trainer_streams)]
+            start = timeit.default_timer()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+            duration = max(timeit.default_timer() - start, 1e-9)
+        queue.shutdown()
+        if errors:
+            raise errors[0]
+        after = rsdl_stats.queue_serve_totals()
+        delta = {key: after[key] - before[key]
+                 for key in ("queue_payload_bytes", "queue_bytes_on_wire",
+                             "queue_handle_hits", "queue_handle_misses",
+                             "queue_compression_saved_bytes")}
+        return sum(counts) / duration, delta
+
+    single_rate, _single = _drain(1, "auto")
+    sharded_rate, handle_delta = _drain(shards, "auto")
+    _stream_rate, stream_delta = _drain(shards, "stream")
+
+    wire_handle = max(1, handle_delta["queue_bytes_on_wire"])
+    wire_stream = stream_delta["queue_bytes_on_wire"]
+    saved = stream_delta["queue_compression_saved_bytes"]
+    compression_ratio = (
+        (wire_stream + saved) / wire_stream if wire_stream else 1.0)
+    return {
+        "serve_shards": shards,
+        "serve_trainer_streams": trainer_streams,
+        "serve_rows_per_sec": round(sharded_rate, 1),
+        "serve_rows_per_sec_single_shard": round(single_rate, 1),
+        "serve_speedup_vs_single_shard": round(
+            sharded_rate / single_rate, 3) if single_rate else None,
+        "queue_bytes_on_wire": handle_delta["queue_bytes_on_wire"],
+        "queue_bytes_on_wire_stream": wire_stream,
+        "serve_handle_wire_reduction_x": round(
+            wire_stream / wire_handle, 1),
+        "queue_handle_hits": handle_delta["queue_handle_hits"],
+        "queue_handle_misses": handle_delta["queue_handle_misses"],
+        "queue_compression_ratio": round(compression_ratio, 4),
+    }
+
+
 def main() -> None:
     if os.environ.get("RSDL_BENCH_CPU"):
         os.environ.setdefault(
@@ -1185,7 +1304,7 @@ def main() -> None:
     step_ms = float(os.environ.get("RSDL_BENCH_STEP_MS", 0))
 
     phases = [p.strip() for p in os.environ.get(
-        "RSDL_BENCH_PHASES", "cached,cold,train,scaling").split(",")
+        "RSDL_BENCH_PHASES", "cached,cold,train,scaling,serve").split(",")
         if p.strip()]
     if os.environ.get("RSDL_BENCH_COLD"):
         # Legacy knob: the cold regime IS the headline; skip cached.
@@ -1223,7 +1342,7 @@ def main() -> None:
     fs_before = rsdl_stats.fault_stats().snapshot()
     recovery_before = rsdl_stats.process_recovery_totals()
 
-    cached = cold = train = train_agg = scaling = None
+    cached = cold = train = train_agg = scaling = serve = None
 
     def _phase(name, fn):
         """Run one phase; a failed phase is reported and OMITTED from the
@@ -1316,6 +1435,16 @@ def main() -> None:
                          f"{scaling['parallel_efficiency']:.2f})"
                          if "parallel_efficiency" in scaling else ""),
                       file=sys.stderr)
+        if "serve" in phases:
+            serve = _phase("serve", lambda: _run_serve_leg(filenames))
+            if serve is not None:
+                print(f"# serve: {serve['serve_rows_per_sec']:,.0f} rows/s "
+                      f"aggregate over {serve['serve_trainer_streams']} "
+                      f"remote streams on {serve['serve_shards']} shards "
+                      f"({serve['serve_speedup_vs_single_shard']}x of 1 "
+                      f"shard); handle delivery cut wire bytes "
+                      f"{serve['serve_handle_wire_reduction_x']}x",
+                      file=sys.stderr)
         if "train" in phases:
             train_epochs = int(os.environ.get("RSDL_BENCH_TRAIN_EPOCHS", 4))
             train_batch = int(os.environ.get("RSDL_BENCH_TRAIN_BATCH",
@@ -1405,6 +1534,15 @@ def main() -> None:
         # Train-only run: the headline is the train-gated rate (the train
         # phase runs with the cache ON, so the cold metric name would lie).
         headline, metric = train, "train_gated_rows_per_sec_per_chip"
+    elif serve is not None:
+        # Serve-only run (RSDL_BENCH_PHASES=serve): the headline is the
+        # serving plane's aggregate remote-stream rate; the ingest-phase
+        # stall/fill fields do not exist here and report as zero.
+        headline = {"rows_per_s": serve["serve_rows_per_sec"],
+                    "stall_pct": 0.0, "stall_s": 0.0,
+                    "wait_mean_ms": 0.0, "timed_epochs": 1,
+                    "duration_s": 0.0}
+        metric = "serve_rows_per_sec_aggregate"
     else:
         print(f"no phase produced a result (selected: {phases!r}; a "
               "'# <name> phase FAILED' line above means the phase ran "
@@ -1470,6 +1608,14 @@ def main() -> None:
         # Worker-count scaling leg (1 -> N): near-linear scaling must be
         # an artifact in the record, not a claim in prose.
         record["worker_scaling"] = scaling
+    if serve is not None:
+        # Serving-plane leg (multiqueue_service v3): flat keys so the
+        # bench-diff gate and the trial CSV read them like any other
+        # metric — shard-scaling ratio, per-layer wire bytes
+        # (handle vs stream on the SAME table flow), and the
+        # compression ratio. A serve_rows_per_sec drop fails --baseline
+        # like any other regression.
+        record.update(serve)
     # Runtime-health evidence (runtime/watchdog.py): deadline misses on
     # the supervised bulk transfer/carve path, escalations (a stall
     # persisting past further deadline multiples), and whether the

@@ -33,7 +33,7 @@ pragma IS the blessing mechanism.
 from __future__ import annotations
 
 import ast
-from typing import Iterator
+from typing import Iterator, Optional
 
 from ray_shuffling_data_loader_tpu.analysis.core import (FileContext, Rule,
                                                          Violation,
@@ -92,3 +92,84 @@ class CopyInHotPathRule(Rule):
                     "into fresh buffers; bless only once-per-cache-entry "
                     "sites (pragma + justification) — per-call sites must "
                     "stay chunked")
+
+
+def _is_bytes_init(value: ast.expr) -> bool:
+    """``b"..."`` literal, or a ``bytes(...)`` call — the accumulator
+    shapes that make ``buf += chunk`` provably a bytes concatenation."""
+    if isinstance(value, ast.Constant) and isinstance(value.value, bytes):
+        return True
+    return (isinstance(value, ast.Call)
+            and isinstance(value.func, ast.Name)
+            and value.func.id == "bytes")
+
+
+@register
+class BytesConcatInLoopRule(Rule):
+    id = "bytes-concat-in-loop"
+    category = "perf"
+    description = ("flag `buf += chunk` / `buf = buf + chunk` inside a "
+                   "loop when buf was initialized from a bytes literal "
+                   "or bytes() — quadratic on large frames; accumulate "
+                   "into a bytearray or collect chunks and b\"\".join")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        scopes = [tree] + [n for n in ast.walk(tree)
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]
+        for scope in scopes:
+            # Accumulators PROVABLY bytes: assigned from a bytes literal
+            # or bytes() anywhere in this scope (not a nested function).
+            bytes_vars = set()
+            for node in self._scope_walk(scope):
+                if isinstance(node, ast.Assign) \
+                        and _is_bytes_init(node.value):
+                    for target in node.targets:
+                        if isinstance(target, ast.Name):
+                            bytes_vars.add(target.id)
+            if not bytes_vars:
+                continue
+            for loop in self._scope_walk(scope):
+                if not isinstance(loop, (ast.For, ast.While)):
+                    continue
+                for node in ast.walk(loop):
+                    name = self._concat_target(node)
+                    if name in bytes_vars:
+                        yield ctx.violation(
+                            self, node,
+                            f"`{name} += chunk` on a bytes accumulator "
+                            "inside a loop re-copies every byte "
+                            "accumulated so far (quadratic on large "
+                            "frames); accumulate into a bytearray, or "
+                            "collect chunks in a list and b\"\".join "
+                            "once")
+
+    @staticmethod
+    def _scope_walk(scope: ast.AST) -> Iterator[ast.AST]:
+        """ast.walk that does not descend into nested function scopes
+        (their accumulators are their own scope's business)."""
+        stack = list(ast.iter_child_nodes(scope))
+        while stack:
+            node = stack.pop()
+            yield node
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef, ast.Lambda)):
+                stack.extend(ast.iter_child_nodes(node))
+
+    @staticmethod
+    def _concat_target(node: ast.AST) -> Optional[str]:
+        """The accumulator name of ``x += y`` / ``x = x + y`` (Add only),
+        else None."""
+        if isinstance(node, ast.AugAssign) and isinstance(node.op, ast.Add) \
+                and isinstance(node.target, ast.Name):
+            return node.target.id
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name) \
+                and isinstance(node.value, ast.BinOp) \
+                and isinstance(node.value.op, ast.Add):
+            name = node.targets[0].id
+            for operand in (node.value.left, node.value.right):
+                if isinstance(operand, ast.Name) and operand.id == name:
+                    return name
+        return None

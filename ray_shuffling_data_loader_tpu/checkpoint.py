@@ -89,6 +89,19 @@ class WatermarkEntry:
     done: bool = False
 
 
+def shard_journal_path(path: str, shard_index: int, num_shards: int) -> str:
+    """The watermark-journal path for one serving-plane shard.
+
+    Each shard journals ONLY its owned ranks' queues (the
+    ``plan.ir.queue_shard`` placement), so the PR 5 recovery matrix
+    holds per shard: a restarted shard replays from its own journal
+    without reading (or racing) its siblings'. The single-shard name is
+    unchanged so pre-sharding journals keep resuming."""
+    if num_shards <= 1:
+        return path
+    return f"{path}.shard{shard_index}"
+
+
 class WatermarkJournal:
     """Crc'd append-only journal of per-queue delivered watermarks.
 

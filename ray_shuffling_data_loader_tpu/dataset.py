@@ -166,6 +166,22 @@ def create_batch_queue_and_shuffle(
     return batch_queue, shuffle_result
 
 
+def connect_remote_queue(target, **remote_kwargs):
+    """One connector for every remote-queue topology: pass a single
+    ``(host, port)`` and get a ``multiqueue_service.RemoteQueue``; pass
+    a shard map (a ``plan.ir.ShardMap``, its dict, or its JSON — what
+    ``runtime.supervisor.launch_supervised_queue_shards`` returns) and
+    get a ``multiqueue_service.ShardedRemoteQueue`` that routes each
+    per-rank stream to its serving shard. Either return value drops
+    into ``ShufflingDataset(batch_queue=...)`` unchanged — consumer
+    code does not know how many shards serve it."""
+    from ray_shuffling_data_loader_tpu import multiqueue_service as svc
+    if isinstance(target, tuple) and len(target) == 2 \
+            and isinstance(target[0], str):
+        return svc.RemoteQueue(target, **remote_kwargs)
+    return svc.ShardedRemoteQueue(target, **remote_kwargs)
+
+
 class ShufflingDataset:
     """Iterable dataset of exact-size shuffled batches
     (reference: dataset.py:53-210).

@@ -219,6 +219,15 @@ class MultiQueue:
         """
         return self._queues[queue_index].qsize()
 
+    def sizes(self, indices: Optional[List[int]] = None) -> List[int]:
+        """Approximate depths of several queues in one pass (all of
+        them when ``indices`` is None) — the serving plane's per-shard
+        depth gauge reads its owned queues through this instead of N
+        lock round trips through :meth:`size`."""
+        queues = (self._queues if indices is None
+                  else [self._queues[i] for i in indices])
+        return [q.qsize() for q in queues]
+
     def _check_open(self) -> None:
         if self._shutdown_event.is_set():
             raise RuntimeError(f"MultiQueue {self._name!r} is shut down")

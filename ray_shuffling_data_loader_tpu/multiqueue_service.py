@@ -89,25 +89,61 @@ the full process-failure matrix):
   ``RSDL_QUEUE_ON_DEAD_CONSUMER`` = ``fail_fast`` | ``drain`` |
   ``redistribute`` decides whether the pipeline dies loudly, frees the
   dead rank's queues, or reroutes its undelivered tables to survivors.
+
+Wire format **v3** (sharded zero-copy serving plane) extends v2 in
+place — same request struct, same frame struct, same recovery matrix:
+
+- The frame ``kind`` byte now carries a codec in its high nibble
+  (``kind | codec << 4``; codec 0 = none, 1 = zlib, 2 = zstd, 3 = lz4).
+  Streamed table payloads at/above ``RSDL_QUEUE_COMPRESSION_MIN_BYTES``
+  are compressed when ``RSDL_QUEUE_COMPRESSION`` names a codec; ``crc``
+  is computed over the UNCOMPRESSED payload, so corruption detection
+  and NACK/replay semantics are byte-for-byte the v2 ones.
+- New frame kind ``KIND_TABLE_HANDLE``: when server and consumer share
+  a host (the consumer offered ``FLAG_HANDLES_OK`` on its HELLO), a
+  table frame's payload is a ~100-byte shm **segment handle**
+  (``{"path", "offset", "size", "crc"}``) instead of the table bytes —
+  the consumer mmaps the very buffers the server serialized
+  (``procpool.read_segment_buffer``), verifies the segment CRC off the
+  mapped pages, and acks by seq exactly as before. The replay buffer
+  retains the handle and PINS the segment via the NativeBufferPool
+  ledger (``procpool.pin_segment``) until the ack lands — unacked
+  bytes stay accounted, but exist exactly once, in shared memory.
+  ``OP_NACK`` with ``c=1`` (``NACK_NO_HANDLE``) reports an unusable
+  handle (a mis-detected host split, a vanished segment): the server
+  marks that queue stream-only, rewinds, and replays the same frames
+  as byte streams — delivery degrades, exactly-once does not.
+- Queues are served by N **shard** processes placed by the plan query
+  ``plan.ir.queue_shard`` (by trainer rank, so one rank's whole stream
+  lives on one shard); a :class:`plan.ir.ShardMap` replaces the single
+  ``(host, port)``. :class:`ShardedQueueServer` /
+  :class:`ShardedRemoteQueue` are the in-process pair;
+  ``runtime.supervisor.launch_supervised_queue_shards`` is the
+  per-shard-supervised-process topology, each shard with its own
+  watermark journal (``checkpoint.shard_journal_path``).
 """
 
 from __future__ import annotations
 
 import collections
 import concurrent.futures as cf
+import itertools
 import json
 import os
+import shutil
 import socket
 import struct
 import sys
+import tempfile
 import threading
 import time
 import zlib
-from typing import Dict, List, Optional, Tuple
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 import pyarrow as pa
 
 from ray_shuffling_data_loader_tpu import multiqueue as mq
+from ray_shuffling_data_loader_tpu import procpool as pp
 from ray_shuffling_data_loader_tpu.dataset import ShuffleFailure
 from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
@@ -133,20 +169,88 @@ OP_HEARTBEAT = 3
 OP_NACK = 4
 
 FLAG_RESUME = 1
+#: OP_HELLO flag: the consumer can mmap paths on the server's host
+#: (loopback or a shared shm mount) — the server may answer table GETs
+#: with segment handles instead of streamed bytes.
+FLAG_HANDLES_OK = 2
 
 KIND_TABLE = 0
 KIND_SENTINEL = 1
 KIND_FAILURE = 2
+#: Table delivered as a shm segment handle (payload = JSON blob with
+#: path/offset/size/crc); the header CRC covers the blob itself.
+KIND_TABLE_HANDLE = 3
+
+#: High nibble of the frame kind byte: payload codec.
+_KIND_MASK = 0x0F
+CODEC_NONE, CODEC_ZLIB, CODEC_ZSTD, CODEC_LZ4 = 0, 1, 2, 3
+_CODEC_IDS = {"zlib": CODEC_ZLIB, "zstd": CODEC_ZSTD, "lz4": CODEC_LZ4}
+
+#: OP_NACK ``c`` field: 0 = CRC corruption (rewind + re-send), 1 = the
+#: consumer cannot use shm handles on this queue (downgrade to stream).
+NACK_CRC = 0
+NACK_NO_HANDLE = 1
 
 #: "no watermark" on the wire (seq is u32; -1 internally).
 ACK_NONE = 0xFFFFFFFF
 
 DEFAULT_MAX_BATCH = 8
 
+_LOOPBACK_HOSTS = frozenset({"127.0.0.1", "localhost", "::1"})
+
 
 def _crc(payload) -> int:
     """CRC-32 (zlib) of a bytes-like payload, as an unsigned u32."""
     return zlib.crc32(memoryview(payload)) & 0xFFFFFFFF
+
+
+_codec_warned: set = set()
+
+
+def _resolve_compression() -> Optional[Tuple[int, Callable]]:
+    """``(codec_id, compress)`` for the RSDL_QUEUE_COMPRESSION policy, or
+    None when off. zstd/lz4 degrade to zlib with a one-time warning when
+    the codec module is not importable (nothing is pip-installed here)."""
+    name = str(rt_policy.resolve("queue", "queue_compression")).strip()
+    name = name.lower()
+    if name in ("", "off", "0", "none", "false"):
+        return None
+    if name not in _CODEC_IDS:
+        raise ValueError(
+            f"RSDL_QUEUE_COMPRESSION must be off, zlib, zstd or lz4; "
+            f"got {name!r}")
+    if name == "zstd":
+        try:
+            import zstandard
+            return CODEC_ZSTD, zstandard.ZstdCompressor().compress
+        except ImportError:
+            pass
+    elif name == "lz4":
+        try:
+            import lz4.frame
+            return CODEC_LZ4, lz4.frame.compress
+        except ImportError:
+            pass
+    if name != "zlib" and name not in _codec_warned:
+        _codec_warned.add(name)
+        logger.warning("queue compression codec %r is not installed; "
+                       "degrading to zlib", name)
+    # level 1: the wire win is latency-bound, not ratio-bound. zlib
+    # accepts any buffer-protocol object, so pa.Buffer payloads compress
+    # without an intermediate bytes copy.
+    return CODEC_ZLIB, lambda data: zlib.compress(data, 1)
+
+
+def _decompress(codec: int, payload) -> bytes:
+    if codec == CODEC_ZLIB:
+        return zlib.decompress(payload)
+    if codec == CODEC_ZSTD:
+        import zstandard
+        return zstandard.ZstdDecompressor().decompress(bytes(payload))
+    if codec == CODEC_LZ4:
+        import lz4.frame
+        return lz4.frame.decompress(bytes(payload))
+    raise ValueError(f"unknown frame codec {codec}")
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -159,6 +263,24 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
         chunks.append(chunk)
         remaining -= len(chunk)
     return b"".join(chunks) if len(chunks) != 1 else chunks[0]
+
+
+def _recv_payload(sock: socket.socket, n: int) -> memoryview:
+    """Receive exactly ``n`` payload bytes into ONE preallocated buffer
+    via ``recv_into`` — no per-chunk bytes objects, no join copy (the
+    v2 path built a chunk list and re-copied it into one ``bytes``;
+    large frames paid the payload twice). The returned memoryview is
+    held end to end: CRC, decompression and Arrow IPC decode all read
+    it in place."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    received = 0
+    while received < n:
+        got = sock.recv_into(view[received:], n - received)
+        if not got:
+            raise ConnectionError("peer closed connection mid-message")
+        received += got
+    return view
 
 
 def _serialize(table: pa.Table) -> pa.Buffer:
@@ -183,9 +305,11 @@ def _producer_task(table: pa.Table) -> int:
         return TASK_NONE
 
 
-def _item_frame(item) -> Tuple[int, bytes, int, int]:
-    """Convert one queued item into a ``(kind, payload, num_rows, task)``
-    frame — ``task`` carries the producer's lineage id onto the wire."""
+def _materialize(item) -> Tuple[int, object, int, int]:
+    """Resolve one queued item into ``(kind, data, num_rows, task)`` —
+    ``data`` is the pa.Table for KIND_TABLE (serialization is the frame
+    builder's business, because handle delivery writes a segment instead
+    of wire bytes) and the payload bytes for sentinel/failure frames."""
     if item is None:
         return KIND_SENTINEL, b"", 0, TASK_NONE
     if isinstance(item, ShuffleFailure):
@@ -194,8 +318,7 @@ def _item_frame(item) -> Tuple[int, bytes, int, int]:
         table = item.result() if hasattr(item, "result") else item
         from ray_shuffling_data_loader_tpu import spill
         table = spill.unwrap(table)
-        return (KIND_TABLE, _serialize(table), table.num_rows,
-                _producer_task(table))
+        return KIND_TABLE, table, table.num_rows, _producer_task(table)
     except Exception as e:  # noqa: BLE001 - forwarded
         # A failed shuffle task ref: the consumer gets the real cause as
         # a failure frame, not a dead socket.
@@ -203,27 +326,54 @@ def _item_frame(item) -> Tuple[int, bytes, int, int]:
 
 
 class _Frame:
-    """One serialized response frame held in the server replay buffer."""
+    """One response frame held in the server replay buffer.
 
-    __slots__ = ("seq", "kind", "epoch", "payload", "crc", "row_offset",
-                 "nrows", "task")
+    ``wire`` is the exact on-wire payload (a pa.Buffer / memoryview /
+    bytes — built once, never re-copied); ``crc`` covers the logical
+    payload (pre-compression; for handle frames, the blob itself, with
+    the segment CRC inside the blob); ``data_crc`` is the CRC of the
+    serialized TABLE bytes, kept so a handle frame can be downgraded to
+    a byte stream without re-CRCing the segment. ``payload_bytes`` is
+    the logical (uncompressed) size; handle frames pin that many shm
+    bytes in the buffer ledger (``ledger_id``) until acked.
+    """
 
-    def __init__(self, seq, kind, epoch, payload, crc, row_offset, nrows,
-                 task=TASK_NONE):
+    __slots__ = ("seq", "kind", "epoch", "wire", "crc", "row_offset",
+                 "nrows", "task", "codec", "payload_bytes", "data_crc",
+                 "handle_path", "ledger_id")
+
+    def __init__(self, seq, kind, epoch, wire, crc, row_offset, nrows,
+                 task=TASK_NONE, codec=CODEC_NONE, payload_bytes=None,
+                 data_crc=None, handle_path=None, ledger_id=None):
         self.seq = seq
         self.kind = kind
         self.epoch = epoch
-        self.payload = payload
+        self.wire = wire
         self.crc = crc
         self.row_offset = row_offset
         self.nrows = nrows
         self.task = task
+        self.codec = codec
+        self.payload_bytes = (payload_bytes if payload_bytes is not None
+                              else self.wire_len)
+        self.data_crc = data_crc if data_crc is not None else crc
+        self.handle_path = handle_path
+        self.ledger_id = ledger_id
+
+    @property
+    def wire_len(self) -> int:
+        wire = self.wire
+        return wire.size if isinstance(wire, pa.Buffer) else len(wire)
 
     @property
     def size(self) -> int:
-        payload = self.payload
-        return payload.size if isinstance(payload, pa.Buffer) \
-            else len(payload)
+        """Bytes this unacked frame actually holds resident — the shm
+        segment for handle frames, the (possibly compressed) wire
+        payload otherwise. Each byte is charged exactly once: the wire
+        buffer IS the replay copy, never a second materialization."""
+        if self.kind == KIND_TABLE_HANDLE:
+            return self.payload_bytes
+        return self.wire_len
 
 
 class _QueueState:
@@ -231,7 +381,8 @@ class _QueueState:
     by the ``queue_id = epoch * num_trainers + rank`` contract)."""
 
     __slots__ = ("next_seq", "sent_seq", "acked_seq", "acked_rows",
-                 "rows_total", "replay", "replay_bytes", "done", "lock")
+                 "rows_total", "replay", "replay_bytes", "done", "lock",
+                 "no_handles")
 
     def __init__(self, next_seq: int = 0, rows: int = 0,
                  done: bool = False):
@@ -244,6 +395,7 @@ class _QueueState:
         self.replay_bytes = 0
         self.done = done               # sentinel acked: queue complete
         self.lock = threading.Lock()
+        self.no_handles = False        # NACK_NO_HANDLE: stream-only
 
 
 class _Lease:
@@ -292,11 +444,15 @@ class QueueServer:
     def __init__(self, queue: mq.MultiQueue, address: Tuple[str, int],
                  num_trainers: int = 1, journal=None,
                  initial_state: Optional[Dict[int, object]] = None,
-                 exit_on_crash_site: bool = False):
+                 exit_on_crash_site: bool = False,
+                 shard_index: int = 0, num_shards: int = 1,
+                 handle_dir: Optional[str] = None):
         self._queue = queue
         self._num_trainers = max(1, num_trainers)
         self._journal = journal
         self._exit_on_crash_site = exit_on_crash_site
+        self._shard_index = shard_index
+        self._num_shards = max(1, num_shards)
         self._timeout_s = rt_policy.resolve("queue", "queue_timeout_s")
         self._nodelay = rt_policy.resolve("queue", "queue_nodelay")
         self._replay_budget = rt_policy.resolve("queue",
@@ -310,6 +466,40 @@ class QueueServer:
             raise ValueError(
                 f"RSDL_QUEUE_ON_DEAD_CONSUMER must be fail_fast, drain, or "
                 f"redistribute, got {self._on_dead_consumer!r}")
+        self._delivery = rt_policy.resolve("queue", "queue_delivery")
+        if self._delivery not in ("auto", "stream", "handle"):
+            raise ValueError(
+                f"RSDL_QUEUE_DELIVERY must be auto, stream or handle, "
+                f"got {self._delivery!r}")
+        self._compression = _resolve_compression()
+        self._compression_min = rt_policy.resolve(
+            "queue", "queue_compression_min_bytes")
+        self._handle_dir = handle_dir
+        self._own_handle_dir = False
+        self._handle_names = itertools.count()
+        shard = str(shard_index)
+        self._payload_bytes = rt_metrics.counter(
+            "rsdl_queue_payload_bytes_total",
+            "logical (uncompressed) table-payload bytes served",
+            shard=shard)
+        self._wire_bytes = rt_metrics.counter(
+            "rsdl_queue_bytes_on_wire_total",
+            "payload bytes actually written to consumer sockets",
+            shard=shard)
+        self._handle_hits = rt_metrics.counter(
+            "rsdl_queue_handle_hits_total",
+            "table frames delivered as shm segment handles", shard=shard)
+        self._handle_misses = rt_metrics.counter(
+            "rsdl_queue_handle_misses_total",
+            "table frames streamed as bytes (no handle possible)",
+            shard=shard)
+        self._compression_saved = rt_metrics.counter(
+            "rsdl_queue_compression_saved_bytes_total",
+            "payload bytes saved by frame compression", shard=shard)
+        self._shard_depth = rt_metrics.gauge(
+            "rsdl_queue_shard_depth",
+            "items resident across this shard's served queues",
+            shard=shard)
         self._states: Dict[int, _QueueState] = {}
         self._states_lock = threading.Lock()
         if initial_state:
@@ -404,6 +594,96 @@ class QueueServer:
     def _epoch_of(self, queue_idx: int) -> int:
         return plan_ir.queue_epoch(queue_idx, self._num_trainers)
 
+    def _owns_queue(self, queue_idx: int) -> bool:
+        return (self._num_shards <= 1
+                or plan_ir.queue_shard(queue_idx, self._num_trainers,
+                                       self._num_shards)
+                == self._shard_index)
+
+    def _ensure_handle_dir(self) -> Optional[str]:
+        """The segment dir for handle frames (created on first use under
+        the procpool shm root, or the path the supervised config pinned
+        so restarts reuse it)."""
+        if self._handle_dir is None:
+            self._handle_dir = tempfile.mkdtemp(
+                prefix=f"rsdl-qhandles-s{self._shard_index}-",
+                dir=pp.shm_base_dir())
+            self._own_handle_dir = True
+        else:
+            os.makedirs(self._handle_dir, exist_ok=True)
+        return self._handle_dir
+
+    def _release_frame(self, frame: _Frame) -> None:
+        """Drop an unacked frame's resident bytes: unpin (and unlink)
+        the shm segment for handle frames — consumers that already
+        mmap'd it keep their mapping."""
+        pp.release_segment(frame.ledger_id, frame.handle_path,
+                           unlink=True)
+        frame.ledger_id = None
+
+    def _make_frame(self, queue_idx: int, seq: int, kind: int, data,
+                    nrows: int, task: int, row_offset: int,
+                    want_handle: bool) -> _Frame:
+        """Build one frame, serializing the table exactly once. Handle
+        delivery publishes the serialized buffer as a shm segment and
+        puts only the ~100-byte handle blob on the wire; streamed
+        delivery keeps the pa.Buffer AS the wire payload (the same
+        object rides the socket and the replay buffer — satellite fix:
+        no fresh ``bytes`` copy), optionally compressed."""
+        epoch = self._epoch_of(queue_idx)
+        if kind != KIND_TABLE:
+            return _Frame(seq, kind, epoch, data, _crc(data), row_offset,
+                          nrows, task)
+        buf = _serialize(data)
+        logical = buf.size
+        data_crc = _crc(buf)
+        if want_handle and self._delivery != "stream":
+            path = os.path.join(
+                self._ensure_handle_dir(),
+                f"h{os.getpid()}_{next(self._handle_names)}.arrow")
+            pp.write_buffer_segment(buf, path)
+            ledger_id = pp.pin_segment(logical)
+            blob = json.dumps({"path": path, "offset": 0,
+                               "size": logical,
+                               "crc": data_crc}).encode()
+            self._handle_hits.inc()
+            return _Frame(seq, KIND_TABLE_HANDLE, epoch, blob, _crc(blob),
+                          row_offset, nrows, task,
+                          payload_bytes=logical, data_crc=data_crc,
+                          handle_path=path, ledger_id=ledger_id)
+        self._handle_misses.inc()
+        wire: object = buf
+        codec = CODEC_NONE
+        if self._compression and logical >= self._compression_min:
+            codec_id, compress = self._compression
+            compressed = compress(buf)
+            if len(compressed) < logical:
+                wire, codec = compressed, codec_id
+                self._compression_saved.inc(logical - len(compressed))
+        return _Frame(seq, KIND_TABLE, epoch, wire, data_crc, row_offset,
+                      nrows, task, codec=codec, payload_bytes=logical,
+                      data_crc=data_crc)
+
+    def _downgrade_frame(self, frame: _Frame) -> _Frame:
+        """Replay a handle frame as a byte stream (NACK_NO_HANDLE): mmap
+        the segment the server itself wrote and make its buffer the wire
+        payload. Seq/row accounting and the segment pin carry over, so
+        ack release and exactly-once hold unchanged; the CRC is the
+        stored segment CRC — the bytes are identical by construction."""
+        buf = pp.read_segment_buffer(frame.handle_path)
+        return _Frame(frame.seq, KIND_TABLE, frame.epoch, buf,
+                      frame.data_crc, frame.row_offset, frame.nrows,
+                      frame.task, payload_bytes=frame.payload_bytes,
+                      data_crc=frame.data_crc,
+                      handle_path=frame.handle_path,
+                      ledger_id=frame.ledger_id)
+
+    def _note_shard_depth(self) -> None:
+        if rt_telemetry.stamp():
+            with self._states_lock:
+                queues = list(self._states)
+            self._shard_depth.set(sum(self._queue.sizes(queues)))
+
     def _apply_ack(self, queue_idx: int, state: _QueueState,
                    ack: int) -> None:
         state.acked_seq = ack
@@ -411,6 +691,7 @@ class QueueServer:
         while state.replay and state.replay[0].seq <= ack:
             frame = state.replay.popleft()
             state.replay_bytes -= frame.size
+            self._release_frame(frame)
             state.acked_rows = frame.row_offset + frame.nrows
             if frame.kind == KIND_SENTINEL:
                 done = True
@@ -421,9 +702,13 @@ class QueueServer:
 
     def _collect_frames(self, queue_idx: int, max_items: int,
                         ack: Optional[int], resume: bool,
-                        consumer_id) -> Optional[List[_Frame]]:
+                        consumer_id,
+                        handles_ok: bool = False) -> Optional[List[_Frame]]:
         """Assemble one response: unacked replay suffix first, then new
         pops. Returns None when the server closed under the blocking get.
+        ``handles_ok`` is the CONNECTION's capability (the consumer's
+        HELLO offered shm-handle delivery); a queue NACK'd with
+        NACK_NO_HANDLE stays stream-only regardless.
         """
         # Fault site: a crash HERE models the whole server process dying
         # mid-epoch (the supervisor's recovery unit). In dedicated-server
@@ -439,12 +724,24 @@ class QueueServer:
             raise
         state = self._state(queue_idx)
         with state.lock:
+            want_handle = handles_ok and not state.no_handles
             if ack is not None and ack > state.acked_seq:
                 self._apply_ack(queue_idx, state, ack)
             if resume:
                 # Reconnect: rewind the send cursor to the watermark so
                 # the unacked suffix replays — the frames a reset ate.
                 state.sent_seq = state.acked_seq
+            if not want_handle and any(
+                    f.kind == KIND_TABLE_HANDLE and f.seq > state.sent_seq
+                    for f in state.replay):
+                # The consumer (or a NACK_NO_HANDLE) withdrew handle
+                # capability: downgrade the unsent handle frames to byte
+                # streams in place — same seqs, same bytes, same CRCs.
+                state.replay = collections.deque(
+                    self._downgrade_frame(f)
+                    if f.kind == KIND_TABLE_HANDLE
+                    and f.seq > state.sent_seq else f
+                    for f in state.replay)
             frames: List[_Frame] = [f for f in state.replay
                                     if f.seq > state.sent_seq][:max_items]
             if frames:
@@ -453,7 +750,8 @@ class QueueServer:
                                     task=queue_idx, count=len(frames))
             while (len(frames) < max_items
                    and (not frames
-                        or frames[-1].kind == KIND_TABLE)):
+                        or frames[-1].kind in (KIND_TABLE,
+                                               KIND_TABLE_HANDLE))):
                 if frames and state.replay_bytes > self._replay_budget:
                     # Backpressure: unacked bytes are at budget — stop
                     # popping (never below one frame per GET, so the
@@ -465,7 +763,7 @@ class QueueServer:
                     return None if not frames else frames
                 if item is _POP_EMPTY:
                     break
-                kind, payload, nrows, task = _item_frame(item)
+                kind, data, nrows, task = _materialize(item)
                 seq = state.next_seq
                 state.next_seq += 1
                 row_offset = state.rows_total
@@ -476,22 +774,24 @@ class QueueServer:
                     # drop it, but keep the row accounting advancing.
                     state.acked_rows = row_offset + nrows
                     continue
-                frame = _Frame(seq, kind, self._epoch_of(queue_idx),
-                               payload, _crc(payload), row_offset, nrows,
-                               task)
+                frame = self._make_frame(queue_idx, seq, kind, data,
+                                         nrows, task, row_offset,
+                                         want_handle)
                 state.replay.append(frame)
                 state.replay_bytes += frame.size
                 frames.append(frame)
             if frames:
                 state.sent_seq = frames[-1].seq
+        self._note_shard_depth()
         return frames
 
     def _send_frames(self, conn: socket.socket, queue_idx: int,
                      frames: List[_Frame]) -> None:
         conn.sendall(_BATCH_HEADER.pack(len(frames)))
         for frame in frames:
-            size = frame.size
-            header = _FRAME.pack(frame.kind, frame.epoch, frame.seq,
+            size = frame.wire_len
+            kind_byte = frame.kind | (frame.codec << 4)
+            header = _FRAME.pack(kind_byte, frame.epoch, frame.seq,
                                  frame.crc, frame.row_offset, size,
                                  frame.task)
             try:
@@ -521,14 +821,28 @@ class QueueServer:
                 if corrupt:
                     # Flip one payload byte ON THE WIRE only — the replay
                     # buffer keeps the good copy the NACK re-send needs.
-                    damaged = bytearray(memoryview(frame.payload))
+                    damaged = bytearray(memoryview(frame.wire))
                     damaged[-1] ^= 0xFF
                     conn.sendall(damaged)
                 else:
-                    conn.sendall(frame.payload)
+                    # pa.Buffer / memoryview go straight to the socket —
+                    # the serialized table is never flattened into a
+                    # fresh bytes object on this path.
+                    conn.sendall(frame.wire)
+            if frame.kind in (KIND_TABLE, KIND_TABLE_HANDLE):
+                self._wire_bytes.inc(size)
+                self._payload_bytes.inc(frame.payload_bytes)
+
+    def _fail_frame(self, text: bytes) -> bytes:
+        """A one-frame failure response (v2 shape: count + header +
+        payload)."""
+        return (_BATCH_HEADER.pack(1)
+                + _FRAME.pack(KIND_FAILURE, 0, ACK_NONE, _crc(text), 0,
+                              len(text), TASK_NONE) + text)
 
     def _serve_conn(self, conn: socket.socket) -> None:
         consumer_id: Optional[int] = None
+        handles_ok = False
         try:
             while not self._closed.is_set():
                 try:
@@ -542,33 +856,40 @@ class QueueServer:
                 op, flags, a, b, c = _REQUEST.unpack(raw)
                 if op == OP_HELLO:
                     consumer_id = a | (b << 32)
+                    handles_ok = bool(flags & FLAG_HANDLES_OK)
                     self._lease_beat(consumer_id, None)
                     continue
                 if op == OP_HEARTBEAT:
                     self._lease_beat(consumer_id, None)
                     continue
                 if op == OP_NACK:
-                    self._handle_nack(a, b)
+                    self._handle_nack(a, b, c)
                     self._lease_beat(consumer_id, a)
                     continue
                 if op != OP_GET_BATCH:
                     raise ConnectionError(f"unknown request op {op}")
                 queue_idx, max_items = a, b
+                if not self._owns_queue(queue_idx):
+                    # Routing bug (a consumer dialing the wrong shard)
+                    # must fail loudly, not serve a foreign rank's
+                    # stream with divergent seq state.
+                    conn.sendall(self._fail_frame(
+                        f"queue {queue_idx} is not served by shard "
+                        f"{self._shard_index}/{self._num_shards} "
+                        f"(plan query queue_shard)".encode()))
+                    continue
                 ack = None if c == ACK_NONE else c
                 self._lease_beat(consumer_id, queue_idx)
                 try:
                     frames = self._collect_frames(
                         queue_idx, max(1, max_items), ack,
-                        bool(flags & FLAG_RESUME), consumer_id)
+                        bool(flags & FLAG_RESUME), consumer_id,
+                        handles_ok=handles_ok)
                 except mq.ShutdownError as e:
                     # Queue shut down under a blocked GET: fail loudly
                     # (the reference's actor kill surfaced as
                     # RayActorError on the consumer).
-                    text = repr(e).encode()
-                    conn.sendall(
-                        _BATCH_HEADER.pack(1)
-                        + _FRAME.pack(KIND_FAILURE, 0, ACK_NONE,
-                                      _crc(text), 0, len(text)) + text)
+                    conn.sendall(self._fail_frame(repr(e).encode()))
                     return
                 if frames is None:
                     return  # server closing: drain quietly
@@ -584,11 +905,27 @@ class QueueServer:
             with self._conn_lock:
                 self._conn_threads.discard(threading.current_thread())
 
-    def _handle_nack(self, queue_idx: int, bad_seq: int) -> None:
+    def _handle_nack(self, queue_idx: int, bad_seq: int,
+                     mode: int = NACK_CRC) -> None:
         state = self._state(queue_idx)
         with state.lock:
             state.sent_seq = min(state.sent_seq, bad_seq - 1)
+            if mode == NACK_NO_HANDLE:
+                # The consumer cannot map this queue's segments (handle
+                # capability was mis-detected, or the segment vanished):
+                # stream-only from here on; the rewound replay suffix is
+                # downgraded frame-by-frame at the next GET.
+                state.no_handles = True
         self._nacked.inc()
+        if mode == NACK_NO_HANDLE:
+            rt_telemetry.record("handle_downgrade",
+                                epoch=self._epoch_of(queue_idx),
+                                task=queue_idx, seq=bad_seq)
+            logger.warning(
+                "queue %d: consumer cannot use shm handle for frame %d; "
+                "downgrading the queue to streamed delivery", queue_idx,
+                bad_seq)
+            return
         rt_telemetry.record("frame_nack", epoch=self._epoch_of(queue_idx),
                             task=queue_idx, seq=bad_seq)
         logger.warning("queue %d: consumer NACK'd frame %d (CRC mismatch); "
@@ -684,6 +1021,8 @@ class QueueServer:
         for q in dead_queues:
             state = self._state(q)
             with state.lock:
+                for frame in state.replay:
+                    self._release_frame(frame)
                 state.replay.clear()
                 state.replay_bytes = 0
         while not self._closed.wait(0.2):
@@ -741,6 +1080,17 @@ class QueueServer:
                     "queue server handler %s did not drain within 5s",
                     thread.name)
         self._accept_thread.join(timeout=2.0)
+        # Release the handle-frame segment pins the replay buffers still
+        # hold (consumers that mmap'd a segment keep their mapping), and
+        # the segment dir if this server created it.
+        with self._states_lock:
+            states = list(self._states.values())
+        for state in states:
+            with state.lock:
+                for frame in state.replay:
+                    self._release_frame(frame)
+        if self._own_handle_dir and self._handle_dir:
+            shutil.rmtree(self._handle_dir, ignore_errors=True)
 
     def __enter__(self) -> "QueueServer":
         return self
@@ -754,11 +1104,84 @@ def serve_queue(queue: mq.MultiQueue,
                 num_trainers: int = 1,
                 journal=None,
                 initial_state: Optional[Dict[int, object]] = None,
-                exit_on_crash_site: bool = False) -> QueueServer:
+                exit_on_crash_site: bool = False,
+                shard_index: int = 0, num_shards: int = 1,
+                handle_dir: Optional[str] = None) -> QueueServer:
     """Start serving ``queue`` on ``address`` (port 0 = ephemeral)."""
     return QueueServer(queue, address, num_trainers=num_trainers,
                        journal=journal, initial_state=initial_state,
-                       exit_on_crash_site=exit_on_crash_site)
+                       exit_on_crash_site=exit_on_crash_site,
+                       shard_index=shard_index, num_shards=num_shards,
+                       handle_dir=handle_dir)
+
+
+class ShardedQueueServer:
+    """N in-process :class:`QueueServer` shards over one ``MultiQueue``.
+
+    The in-process face of the sharded serving plane: each shard owns
+    the queues of its ranks (``plan.ir.queue_shard``), listens on its
+    own port, keeps its own replay/lease/journal state, and publishes
+    per-shard metrics. ``shard_map`` is the :class:`plan.ir.ShardMap`
+    consumers route by (hand it to :class:`ShardedRemoteQueue`). The
+    process-per-shard topology lives in
+    ``runtime.supervisor.launch_supervised_queue_shards``.
+    """
+
+    def __init__(self, queue: mq.MultiQueue, num_shards: int,
+                 num_trainers: int = 1, host: str = "127.0.0.1",
+                 journals: Optional[List] = None,
+                 initial_states: Optional[List] = None,
+                 handle_dir: Optional[str] = None):
+        num_shards = max(1, num_shards)
+        self.servers: List[QueueServer] = []
+        try:
+            for shard in range(num_shards):
+                self.servers.append(QueueServer(
+                    queue, (host, 0), num_trainers=num_trainers,
+                    journal=journals[shard] if journals else None,
+                    initial_state=(initial_states[shard]
+                                   if initial_states else None),
+                    shard_index=shard, num_shards=num_shards,
+                    handle_dir=(os.path.join(handle_dir, f"s{shard}")
+                                if handle_dir else None)))
+        except BaseException:
+            self.close()
+            raise
+        self.shard_map = plan_ir.ShardMap(
+            num_trainers=max(1, num_trainers),
+            addresses=[s.address for s in self.servers])
+        rt_metrics.gauge(
+            "rsdl_queue_serve_shards",
+            "shard count of the live queue serving plane").set(num_shards)
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.servers)
+
+    def close(self) -> None:
+        for server in self.servers:
+            server.close()
+
+    def __enter__(self) -> "ShardedQueueServer":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def serve_queue_sharded(queue: mq.MultiQueue,
+                        num_shards: Optional[int] = None,
+                        num_trainers: int = 1,
+                        host: str = "127.0.0.1",
+                        **kwargs) -> ShardedQueueServer:
+    """Shard-serve ``queue`` (``num_shards`` defaults to the
+    ``RSDL_QUEUE_SHARDS`` policy; 1 reproduces the single-server
+    topology exactly)."""
+    if num_shards is None:
+        num_shards = rt_policy.resolve("queue", "queue_shards")
+    return ShardedQueueServer(queue, num_shards,
+                              num_trainers=num_trainers, host=host,
+                              **kwargs)
 
 
 class RemoteQueue:
@@ -801,12 +1224,30 @@ class RemoteQueue:
                  max_batch: int = DEFAULT_MAX_BATCH,
                  prefetch: bool = True,
                  ack_mode: str = "delivered",
-                 consumer_id: Optional[int] = None):
+                 consumer_id: Optional[int] = None,
+                 delivery: Optional[str] = None):
         if ack_mode not in ("delivered", "manual"):
             raise ValueError(
                 f"ack_mode must be 'delivered' or 'manual', got {ack_mode!r}")
         self._address = address
         self._ack_mode = ack_mode
+        # Shm-handle capability (v3): "auto" offers handles when the
+        # server address is loopback (same host by construction);
+        # "handle" forces the offer (shared shm mounts); "stream" never
+        # offers — the v2 wire exactly. A handle that turns out to be
+        # unusable is NACK'd with NACK_NO_HANDLE and the queue degrades
+        # to streamed delivery, so a wrong "handle" is slow, not wrong.
+        self._delivery = rt_policy.resolve("queue", "queue_delivery",
+                                           override=delivery)
+        if self._delivery not in ("auto", "stream", "handle"):
+            raise ValueError(
+                f"delivery must be auto, stream or handle, "
+                f"got {self._delivery!r}")
+        host = str(address[0])
+        self._offer_handles = (
+            self._delivery == "handle"
+            or (self._delivery == "auto"
+                and (host in _LOOPBACK_HOSTS or host.startswith("127."))))
         self._consumer_id = (consumer_id if consumer_id is not None
                              else int.from_bytes(os.urandom(8), "little"))
         self._timeout_s = rt_policy.resolve("queue", "queue_timeout_s")
@@ -878,7 +1319,9 @@ class RemoteQueue:
             if self._nodelay:
                 sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
             sock.sendall(_REQUEST.pack(
-                OP_HELLO, 0, self._consumer_id & 0xFFFFFFFF,
+                OP_HELLO,
+                FLAG_HANDLES_OK if self._offer_handles else 0,
+                self._consumer_id & 0xFFFFFFFF,
                 (self._consumer_id >> 32) & 0xFFFFFFFF, 0))
             self._sock = sock
             self._fetched_since_connect = set()
@@ -953,16 +1396,29 @@ class RemoteQueue:
                     response_started = True
                     frames = []
                     corrupt_seq = None
+                    handle_fail_seq = None
                     for _ in range(count):
-                        (kind, epoch, seq, crc, row_offset, length,
+                        (kind_byte, epoch, seq, crc, row_offset, length,
                          src_task) = _FRAME.unpack(
                              _recv_exact(self._sock, _FRAME.size))
+                        kind = kind_byte & _KIND_MASK
+                        codec = kind_byte >> 4
                         epoch_hint = epoch
-                        payload = (_recv_exact(self._sock, length)
+                        payload = (_recv_payload(self._sock, length)
                                    if length else b"")
-                        if corrupt_seq is not None:
+                        if corrupt_seq is not None \
+                                or handle_fail_seq is not None:
                             continue  # drain framing past the bad frame
-                        if _crc(payload) != crc:
+                        try:
+                            # CRC is pre-compression: decompress first,
+                            # verify the logical bytes (a torn
+                            # compressed stream raises and is NACK'd
+                            # like any corruption).
+                            raw = (_decompress(codec, payload)
+                                   if codec != CODEC_NONE else payload)
+                        except Exception:  # noqa: BLE001 - NACK'd below
+                            raw = None
+                        if raw is None or _crc(raw) != crc:
                             # End-to-end integrity: reject the frame and
                             # everything after it (in-order delivery),
                             # but keep READING so the stream framing
@@ -978,6 +1434,32 @@ class RemoteQueue:
                                 "queue %d: frame %d failed CRC; NACKing",
                                 queue_index, seq)
                             continue
+                        if kind == KIND_TABLE_HANDLE:
+                            # Shm-handle delivery: mmap the segment the
+                            # server serialized and verify its CRC off
+                            # the mapped pages — zero-copy, nothing but
+                            # the blob crossed the socket. Any failure
+                            # downgrades this queue to streamed bytes
+                            # (NACK_NO_HANDLE below).
+                            try:
+                                handle = json.loads(bytes(raw).decode())
+                                buf = pp.read_segment_buffer(
+                                    handle["path"])
+                                if _crc(buf) != handle["crc"]:
+                                    raise ValueError(
+                                        "segment CRC mismatch")
+                            except (OSError, ValueError, KeyError,
+                                    TypeError) as e:
+                                handle_fail_seq = seq
+                                rt_telemetry.record(
+                                    "handle_downgrade", epoch=epoch,
+                                    task=queue_index, seq=seq)
+                                logger.warning(
+                                    "queue %d: shm handle for frame %d "
+                                    "unusable (%s); requesting streamed "
+                                    "delivery", queue_index, seq, e)
+                                continue
+                            kind, raw = KIND_TABLE, buf
                         if kind == KIND_TABLE and src_task != TASK_NONE:
                             # Cross-process causal link: this frame's
                             # payload was built by reduce task
@@ -987,10 +1469,15 @@ class RemoteQueue:
                             # (epoch, task).
                             rt_telemetry.record("frame_recv", epoch=epoch,
                                                 task=src_task, seq=seq)
-                        frames.append((kind, seq, row_offset, payload))
+                        frames.append((kind, seq, row_offset, raw))
                     if corrupt_seq is not None:
                         self._sock.sendall(_REQUEST.pack(
-                            OP_NACK, 0, queue_index, corrupt_seq, 0))
+                            OP_NACK, 0, queue_index, corrupt_seq,
+                            NACK_CRC))
+                    elif handle_fail_seq is not None:
+                        self._sock.sendall(_REQUEST.pack(
+                            OP_NACK, 0, queue_index, handle_fail_seq,
+                            NACK_NO_HANDLE))
                     self._fetched_since_connect.add(queue_index)
                 return frames, resume
             except (ConnectionError, OSError) as e:
@@ -1032,10 +1519,16 @@ class RemoteQueue:
                 items.append((seq, None, None))
                 break  # epoch over; nothing valid can follow
             if kind == KIND_FAILURE:
-                items.append((seq, None,
-                              ShuffleFailure(RuntimeError(payload.decode()))))
+                items.append((seq, None, ShuffleFailure(
+                    RuntimeError(bytes(payload).decode()))))
                 break
-            with pa.ipc.open_stream(pa.BufferReader(payload)) as reader:
+            # ``payload`` is a pa.Buffer (mmap'd segment), a memoryview
+            # of the recv buffer, or decompressed bytes — all read
+            # zero-copy through py_buffer; the table's Arrow buffers
+            # alias it, so no re-materialization happens here either.
+            source = (payload if isinstance(payload, pa.Buffer)
+                      else pa.py_buffer(payload))
+            with pa.ipc.open_stream(pa.BufferReader(source)) as reader:
                 items.append((seq, row_offset, reader.read_all()))
         return items, resumed
 
@@ -1143,6 +1636,80 @@ class RemoteQueue:
         self.close()
 
 
+class ShardedRemoteQueue:
+    """Consumer-side handle to the sharded serving plane.
+
+    Routes every queue index to its shard by the plan query the server
+    placed it with (:meth:`plan.ir.ShardMap.shard_for_queue`), holding
+    one :class:`RemoteQueue` per shard it actually touches (a trainer
+    rank touches exactly one, by the rank-based placement). Duck-types
+    the ``RemoteQueue`` consumer surface (``get`` / ``get_positioned``
+    / ``commit`` / ``close``), so
+    ``ShufflingDataset(batch_queue=ShardedRemoteQueue(shard_map))`` is
+    the same drop-in remote trainer — each shard connection keeps its
+    own lease, resume watermarks and prefetch pipeline, so one dead
+    shard never stalls a stream served by its siblings.
+    """
+
+    def __init__(self, shard_map: Union[plan_ir.ShardMap, dict, str],
+                 **remote_kwargs):
+        if isinstance(shard_map, str):
+            shard_map = plan_ir.ShardMap.from_json(shard_map)
+        elif isinstance(shard_map, dict):
+            shard_map = plan_ir.ShardMap.from_dict(shard_map)
+        shard_map.validate()
+        self._shard_map = shard_map
+        self._remote_kwargs = remote_kwargs
+        self._clients: Dict[int, RemoteQueue] = {}
+        self._clients_lock = threading.Lock()
+
+    @property
+    def shard_map(self) -> plan_ir.ShardMap:
+        return self._shard_map
+
+    def _client(self, shard: int) -> RemoteQueue:
+        with self._clients_lock:
+            client = self._clients.get(shard)
+            if client is None:
+                client = self._clients[shard] = RemoteQueue(
+                    tuple(self._shard_map.addresses[shard]),
+                    **self._remote_kwargs)
+            return client
+
+    def client_for_queue(self, queue_index: int) -> RemoteQueue:
+        return self._client(self._shard_map.shard_for_queue(queue_index))
+
+    def get_positioned(self, queue_index: int):
+        return self.client_for_queue(queue_index).get_positioned(
+            queue_index)
+
+    def get(self, queue_index: int, block: bool = True):
+        return self.client_for_queue(queue_index).get(queue_index,
+                                                      block=block)
+
+    def commit(self, queue_index: Optional[int] = None) -> None:
+        if queue_index is not None:
+            self.client_for_queue(queue_index).commit(queue_index)
+            return
+        with self._clients_lock:
+            clients = list(self._clients.values())
+        for client in clients:
+            client.commit()
+
+    def close(self) -> None:
+        with self._clients_lock:
+            clients = list(self._clients.values())
+            self._clients.clear()
+        for client in clients:
+            client.close()
+
+    def __enter__(self) -> "ShardedRemoteQueue":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
 # ---------------------------------------------------------------------------
 # Dedicated-server-process mode: build the whole producer pipeline (queue +
 # deterministic shuffle + v2 server) from a config dict, resuming from the
@@ -1151,26 +1718,36 @@ class RemoteQueue:
 
 
 def _resume_plan(state: Dict[int, object], num_epochs: int,
-                 num_trainers: int) -> Tuple[int, Dict[int, int]]:
+                 num_trainers: int,
+                 ranks: Optional[List[int]] = None
+                 ) -> Tuple[int, Dict[int, int]]:
     """``(start_epoch, skip_items)`` from a loaded journal: the first
     epoch any rank has not fully consumed, and per-queue counts of items
     (tables + sentinel) already delivered that the re-run must not
     re-enqueue. The math is a plan query
     (``plan.ir.resume_from_watermarks``) — the server no longer carries
-    private resume arithmetic; this wrapper keeps the historical name."""
-    return plan_ir.resume_from_watermarks(state, num_epochs, num_trainers)
+    private resume arithmetic; this wrapper keeps the historical name.
+    ``ranks`` restricts the scan to a shard's owned ranks."""
+    return plan_ir.resume_from_watermarks(state, num_epochs, num_trainers,
+                                          ranks=ranks)
 
 
 def _resuming_batch_consumer(queue: mq.MultiQueue, num_trainers: int,
-                             skip_items: Dict[int, int]):
+                             skip_items: Dict[int, int],
+                             owned_ranks: Optional[List[int]] = None):
     """``batch_consumer`` that re-runs the lineage but enqueues only the
     undelivered remainder: the first ``skip_items[q]`` items of each
     queue's deterministic stream (tables, then the sentinel) are dropped
-    — they are already journaled as delivered."""
+    — they are already journaled as delivered. A serving SHARD passes
+    its ``owned_ranks`` so foreign ranks' outputs (recomputed by the
+    deterministic lineage regardless) are never enqueued or held."""
     remaining = dict(skip_items)
+    owned = set(owned_ranks) if owned_ranks is not None else None
     lock = threading.Lock()
 
     def consumer(rank, epoch, refs):
+        if owned is not None and rank not in owned:
+            return
         queue_idx = plan_ir.queue_index(epoch, rank, num_trainers)
         with lock:
             to_skip = remaining.get(queue_idx, 0)
@@ -1194,7 +1771,8 @@ def _resuming_batch_consumer(queue: mq.MultiQueue, num_trainers: int,
 
 
 def serve_pipeline(config: dict):
-    """Child-process entry: queue + shuffle + v2 server from ``config``.
+    """Child-process entry: queue + shuffle + v2/v3 server from
+    ``config``.
 
     Resumes from the journal at ``config["journal_path"]``: per-queue
     sequence numbers and row offsets restore to their journaled
@@ -1202,6 +1780,14 @@ def serve_pipeline(config: dict):
     (``(seed, epoch, task)`` determinism makes the re-run bit-identical),
     and already-delivered items are dropped before the queue — so the
     restarted server serves exactly the undelivered remainder.
+
+    Sharding (``config["num_shards"]`` > 1 with ``"shard_index"``): this
+    process serves ONLY the ranks ``plan.ir.shard_ranks`` assigns it —
+    its journal covers exactly those queues, the resume scan is
+    restricted to them, and foreign ranks' regenerated outputs are
+    dropped before the queue. ``config["handle_dir"]`` (optional) pins
+    the shm-handle segment dir so restarts reuse one location; stale
+    segments from a killed incarnation are swept at startup.
 
     Returns ``(server, shuffle_result, queue)``.
     """
@@ -1212,19 +1798,44 @@ def serve_pipeline(config: dict):
 
     num_epochs = int(config["num_epochs"])
     num_trainers = int(config["num_trainers"])
+    num_shards = int(config.get("num_shards", 1))
+    shard_index = int(config.get("shard_index", 0))
+    owned_ranks = (plan_ir.shard_ranks(shard_index, num_trainers,
+                                       num_shards)
+                   if num_shards > 1 else None)
     journal_path = config["journal_path"]
+    handle_dir = config.get("handle_dir")
+    if not handle_dir:
+        # A STABLE per-journal segment dir under shm: a kill -9'd
+        # incarnation cannot clean its segments, so the restarted child
+        # (same journal identity -> same dir) must find and sweep them
+        # instead of leaking shm until reboot.
+        digest = zlib.crc32(os.path.abspath(journal_path).encode())
+        handle_dir = os.path.join(pp.shm_base_dir(),
+                                  f"rsdl-qhandles-{digest:08x}")
+    if os.path.isdir(handle_dir):
+        # Sweep stale segments from the previous incarnation (safe:
+        # consumers mmap segments at fetch time, so a live mapping
+        # survives the unlink).
+        for name in os.listdir(handle_dir):
+            try:
+                os.unlink(os.path.join(handle_dir, name))
+            except OSError:
+                pass
     state = ckpt.WatermarkJournal.load(journal_path)
-    start_epoch, skip_items = _resume_plan(state, num_epochs, num_trainers)
+    start_epoch, skip_items = _resume_plan(state, num_epochs, num_trainers,
+                                           ranks=owned_ranks)
     if state:
         logger.warning(
-            "queue server resuming from journal %s: start_epoch=%d, "
-            "skipping %s already-delivered items",
-            journal_path, start_epoch,
+            "queue server (shard %d/%d) resuming from journal %s: "
+            "start_epoch=%d, skipping %s already-delivered items",
+            shard_index, num_shards, journal_path, start_epoch,
             {q: n for q, n in skip_items.items() if n})
     journal = ckpt.WatermarkJournal(journal_path)
     journal.compact()
     queue = mq.MultiQueue(num_epochs * num_trainers)
-    consumer = _resuming_batch_consumer(queue, num_trainers, skip_items)
+    consumer = _resuming_batch_consumer(queue, num_trainers, skip_items,
+                                        owned_ranks=owned_ranks)
     shuffle_result = sh.run_shuffle_in_background(
         list(config["filenames"]), consumer, num_epochs,
         int(config["num_reducers"]), num_trainers,
@@ -1238,7 +1849,11 @@ def serve_pipeline(config: dict):
     server = QueueServer(
         queue, (config.get("host", "127.0.0.1"), int(config["port"])),
         num_trainers=num_trainers, journal=journal, initial_state=state,
-        exit_on_crash_site=True)
+        exit_on_crash_site=True, shard_index=shard_index,
+        num_shards=num_shards, handle_dir=handle_dir)
+    rt_metrics.gauge(
+        "rsdl_queue_serve_shards",
+        "shard count of the live queue serving plane").set(num_shards)
     return server, shuffle_result, queue
 
 

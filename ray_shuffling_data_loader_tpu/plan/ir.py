@@ -46,6 +46,9 @@ from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
 #: Serialization format version (bumped on breaking shape changes).
 PLAN_VERSION = 1
 
+#: Shard-map serialization version (the serving-plane config, PR 10).
+SHARD_MAP_VERSION = 1
+
 #: Stage names, in dependency order.
 STAGES = ("map", "reduce", "route")
 
@@ -73,6 +76,24 @@ def queue_epoch(queue_idx: int, num_trainers: int) -> int:
 def queue_rank(queue_idx: int, num_trainers: int) -> int:
     """Inverse of :func:`queue_index`: the trainer rank a queue feeds."""
     return queue_idx % num_trainers
+
+
+def queue_shard(queue_idx: int, num_trainers: int, num_shards: int) -> int:
+    """The serving-plane shard responsible for ``queue_idx``.
+
+    Placement is BY RANK (``queue_rank % num_shards``), so every epoch of
+    one trainer's stream lands on the same shard — a consumer holds one
+    connection per shard for its whole run, and a shard's watermark
+    journal covers complete per-rank histories (the per-shard recovery
+    matrix needs no cross-shard coordination)."""
+    return queue_rank(queue_idx, num_trainers) % max(1, num_shards)
+
+
+def shard_ranks(shard: int, num_trainers: int, num_shards: int) -> List[int]:
+    """The trainer ranks (hence queues, across every epoch) shard
+    ``shard`` owns under the :func:`queue_shard` placement."""
+    num_shards = max(1, num_shards)
+    return [r for r in range(num_trainers) if r % num_shards == shard]
 
 
 def split_sizes(total: int, num_parts: int) -> List[int]:
@@ -398,6 +419,90 @@ def build_epoch_plan(filenames: Iterable[str], num_reducers: int,
 
 
 # ---------------------------------------------------------------------------
+# Serving-plane shard map (the PR 10 queue fabric config)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass
+class ShardMap:
+    """The serving plane's one config object: which shard serves which
+    (trainer, epoch) queue, and where each shard listens.
+
+    Replaces the single ``(host, port)`` of the pre-sharded topology.
+    Placement is the :func:`queue_shard` plan query (by rank), so the
+    map is pure data — ``addresses[i]`` is shard ``i``'s ``(host,
+    port)``. Stdlib-only and JSON round-trippable (stable key order)
+    like :class:`EpochPlan`, so tools and child-process configs can
+    carry it verbatim.
+    """
+
+    num_trainers: int
+    addresses: List[Tuple[str, int]]
+    version: int = SHARD_MAP_VERSION
+
+    @property
+    def num_shards(self) -> int:
+        return len(self.addresses)
+
+    def validate(self) -> None:
+        if self.version != SHARD_MAP_VERSION:
+            raise PlanError(
+                f"shard map version {self.version} != {SHARD_MAP_VERSION}")
+        if self.num_trainers < 1:
+            raise PlanError("shard map needs num_trainers >= 1")
+        if not self.addresses:
+            raise PlanError("shard map needs at least one shard address")
+        for addr in self.addresses:
+            if len(tuple(addr)) != 2 or not isinstance(addr[0], str):
+                raise PlanError(f"malformed shard address {addr!r}")
+
+    def shard_for_queue(self, queue_idx: int) -> int:
+        return queue_shard(queue_idx, self.num_trainers, self.num_shards)
+
+    def shard_for_rank(self, rank: int) -> int:
+        return rank % self.num_shards
+
+    def ranks_for_shard(self, shard: int) -> List[int]:
+        return shard_ranks(shard, self.num_trainers, self.num_shards)
+
+    def address_for_queue(self, queue_idx: int) -> Tuple[str, int]:
+        return tuple(self.addresses[self.shard_for_queue(queue_idx)])
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "version": self.version,
+            "num_trainers": self.num_trainers,
+            "addresses": [[host, int(port)]
+                          for host, port in self.addresses],
+        }
+
+    def to_json(self, indent: Optional[int] = None) -> str:
+        return json.dumps(self.to_dict(), indent=indent, sort_keys=False)
+
+    @classmethod
+    def from_dict(cls, data: Mapping[str, Any]) -> "ShardMap":
+        try:
+            shard_map = cls(
+                num_trainers=int(data["num_trainers"]),
+                addresses=[(str(h), int(p)) for h, p in data["addresses"]],
+                version=int(data.get("version", SHARD_MAP_VERSION)))
+        except (KeyError, TypeError, ValueError) as e:
+            raise PlanError(f"malformed shard map: {e}") from e
+        shard_map.validate()
+        return shard_map
+
+    @classmethod
+    def from_json(cls, text: str) -> "ShardMap":
+        try:
+            data = json.loads(text)
+        except json.JSONDecodeError as e:
+            raise PlanError(f"shard map is not valid JSON: {e}") from e
+        if not isinstance(data, dict):
+            raise PlanError("shard map JSON must be an object")
+        return cls.from_dict(data)
+
+
+# ---------------------------------------------------------------------------
 # Resume queries (the PR 5 journal math, now a plan query)
 # ---------------------------------------------------------------------------
 
@@ -410,7 +515,8 @@ def _entry_fields(entry: Any) -> Tuple[int, bool]:
 
 
 def resume_from_watermarks(state: Mapping[int, Any], num_epochs: int,
-                           num_trainers: int
+                           num_trainers: int,
+                           ranks: Optional[Iterable[int]] = None
                            ) -> Tuple[int, Dict[int, int]]:
     """``(start_epoch, skip_items)`` for a restarted producer: the first
     epoch any rank has not fully consumed, and — per queue at/after it —
@@ -418,18 +524,25 @@ def resume_from_watermarks(state: Mapping[int, Any], num_epochs: int,
     already journaled as delivered and must not be re-enqueued.
 
     ``state`` maps queue index -> a ``checkpoint.WatermarkEntry`` (or a
-    dict with ``seq``/``done``). This is the one resume-math
-    implementation; ``multiqueue_service._resume_plan`` and
+    dict with ``seq``/``done``). ``ranks`` restricts the scan to the
+    trainer ranks the caller actually serves — a restarted queue SHARD
+    (``queue_shard`` placement) passes its owned ranks so a foreign
+    rank's absent journal entries cannot drag its start epoch back to
+    zero. This is the one resume-math implementation;
+    ``multiqueue_service._resume_plan`` and
     ``checkpoint.WatermarkJournal.resume_plan`` both delegate here.
     """
+    owned = list(ranks) if ranks is not None else list(range(num_trainers))
     start_epoch = num_epochs
-    for rank in range(num_trainers):
+    for rank in owned:
         for epoch in range(num_epochs):
             entry = state.get(queue_index(epoch, rank, num_trainers))
             if entry is None or not _entry_fields(entry)[1]:
                 start_epoch = min(start_epoch, epoch)
                 break
+    owned_set = set(owned)
     skip_items = {q: _entry_fields(entry)[0] + 1
                   for q, entry in state.items()
-                  if queue_epoch(q, num_trainers) >= start_epoch}
+                  if queue_epoch(q, num_trainers) >= start_epoch
+                  and queue_rank(q, num_trainers) in owned_set}
     return start_epoch, skip_items

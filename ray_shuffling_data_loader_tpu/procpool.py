@@ -190,6 +190,56 @@ def open_table_segment(path: str):
         return pa.ipc.open_file(source).read_all()
 
 
+def write_buffer_segment(buf, path: str) -> int:
+    """Write an already-serialized buffer (e.g. an Arrow IPC stream from
+    ``pa.BufferOutputStream``) at ``path`` with the same tmp + atomic
+    rename discipline as :func:`write_table_segment`. Returns the byte
+    size. The queue serving plane uses this for shm-handle delivery: the
+    one serialization the v2 wire already paid becomes the segment the
+    consumer mmaps, and no byte ever rides the socket."""
+    import pyarrow as pa
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with pa.OSFile(tmp, "wb") as sink:
+        sink.write(buf)
+    os.replace(tmp, path)
+    return os.stat(path).st_size
+
+
+def read_segment_buffer(path: str):
+    """Memory-map a segment back as one zero-copy ``pa.Buffer`` (the raw
+    bytes, not a decoded table): CRC verification and Arrow IPC decode
+    both read straight off the mapped pages."""
+    import pyarrow as pa
+    with pa.memory_map(path) as source:
+        return source.read_buffer()
+
+
+def pin_segment(nbytes: int) -> int:
+    """Charge a segment's bytes to the process-wide buffer ledger
+    (``native.buffer_ledger()``) on behalf of an EXTERNAL consumer — the
+    queue server pins each unacked handle frame's segment so the budget
+    machinery sees replay-held shm like any other in-flight byte.
+    Returns the ledger id for :func:`release_segment`."""
+    from ray_shuffling_data_loader_tpu import native
+    return native.buffer_ledger().register(nbytes)
+
+
+def release_segment(ledger_id: Optional[int], path: Optional[str] = None,
+                    unlink: bool = False) -> None:
+    """Release a :func:`pin_segment` lease (idempotent) and optionally
+    unlink the segment file — consumers that already mmap'd it keep
+    their mapping (POSIX unlink semantics), so acked frames free the
+    name immediately without racing a slow reader."""
+    from ray_shuffling_data_loader_tpu import native
+    if ledger_id is not None:
+        try:
+            native.buffer_ledger().decref(ledger_id)
+        except KeyError:
+            pass
+    if unlink and path:
+        _unlink_quiet(path)
+
+
 def write_index_segment(path: str, offsets: np.ndarray,
                         flat: np.ndarray) -> int:
     """Partition-plan segment: int64 header ``[num_reducers, num_rows]``

@@ -62,6 +62,14 @@ METRIC_NAMES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "rsdl_queue_lease_expiries_total": ("counter", ()),
     "rsdl_queue_consumers_alive": ("gauge", ()),
     "rsdl_queue_server_restarts_total": ("counter", ()),
+    # -- sharded serving plane (multiqueue_service v3, per-shard) --
+    "rsdl_queue_payload_bytes_total": ("counter", ("shard",)),
+    "rsdl_queue_bytes_on_wire_total": ("counter", ("shard",)),
+    "rsdl_queue_handle_hits_total": ("counter", ("shard",)),
+    "rsdl_queue_handle_misses_total": ("counter", ("shard",)),
+    "rsdl_queue_compression_saved_bytes_total": ("counter", ("shard",)),
+    "rsdl_queue_shard_depth": ("gauge", ("shard",)),
+    "rsdl_queue_serve_shards": ("gauge", ()),
     # -- spill tier (spill.py) --
     "rsdl_spills_total": ("counter", ()),
     "rsdl_spilled_bytes_total": ("counter", ()),

@@ -155,6 +155,25 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     # Consumer lease: seconds without a heartbeat/request before a
     # consumer is declared dead. Client heartbeats run at a third of it.
     "queue_lease_timeout_s": (30.0, float),
+    # Serving-plane table delivery (multiqueue_service v3): "auto"
+    # (consumers on a loopback address offer shm-handle delivery and the
+    # server sends segment handles instead of streaming table bytes;
+    # cross-host consumers stream), "handle" (offer handles regardless
+    # of address — containers sharing a shm mount), "stream" (always
+    # stream bytes; the v2 wire exactly).
+    "queue_delivery": ("auto", str),
+    # Frame compression for STREAMED table payloads (handle frames are
+    # ~100 bytes and never compressed): "off" | "zlib" | "zstd" | "lz4".
+    # zstd/lz4 degrade to zlib with a warning when the codec module is
+    # not installed. CRC is computed pre-compression, so corruption
+    # detection and NACK/replay semantics are unchanged.
+    "queue_compression": ("off", str),
+    # Streamed payloads below this size skip compression (header + CPU
+    # overhead dwarfs the saving on small frames).
+    "queue_compression_min_bytes": (4096, int),
+    # Serving-plane shard count consulted by the serve helpers when the
+    # caller does not pass one explicitly (1 = the pre-PR-10 topology).
+    "queue_shards": (1, int),
     # What the server does when a consumer's lease expires
     # (RSDL_QUEUE_ON_DEAD_CONSUMER): "fail_fast" (down the server so the
     # pipeline fails loudly), "drain" (free the dead rank's queues so

@@ -220,6 +220,58 @@ def launch_supervised_queue_server(config: dict,
     return supervisor, (host, config["port"])
 
 
+def launch_supervised_queue_shards(config: dict, num_shards: int,
+                                   name: str = "queue-shard"):
+    """The sharded serving plane as supervised OS processes: one
+    :func:`launch_supervised_queue_server` child per shard, each
+    serving the ranks ``plan.ir.shard_ranks`` assigns it, each with its
+    OWN watermark journal (``checkpoint.shard_journal_path``) and its
+    own restart budget — a ``kill -9`` of one shard recovers exactly
+    like the single-server PR 5 matrix, while its siblings keep
+    serving untouched.
+
+    Returns ``(supervisors, shard_map)`` — ``shard_map`` is the
+    :class:`plan.ir.ShardMap` consumers hand to
+    ``multiqueue_service.ShardedRemoteQueue``.
+    """
+    # Deferred: plan/ir is stdlib-only but lives outside runtime/; the
+    # supervisor stays importable without it on minimal tool images.
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+
+    num_shards = max(1, int(num_shards))
+    config = dict(config)
+    host = config.setdefault("host", "127.0.0.1")
+    journal_path = config["journal_path"]
+    handle_root = config.pop("handle_dir", None)
+    ports = [free_port(host) for _ in range(num_shards)]
+    supervisors = []
+    for shard in range(num_shards):
+        shard_config = dict(
+            config, port=ports[shard], shard_index=shard,
+            num_shards=num_shards,
+            journal_path=_shard_journal_path(journal_path, shard,
+                                             num_shards))
+        if handle_root:
+            shard_config["handle_dir"] = os.path.join(handle_root,
+                                                      f"s{shard}")
+        supervisor, _ = launch_supervised_queue_server(
+            shard_config, name=f"{name}-{shard}")
+        supervisors.append(supervisor)
+    shard_map = plan_ir.ShardMap(
+        num_trainers=max(1, int(config["num_trainers"])),
+        addresses=[(host, port) for port in ports])
+    return supervisors, shard_map
+
+
+def _shard_journal_path(path: str, shard_index: int,
+                        num_shards: int) -> str:
+    """Delegates to ``checkpoint.shard_journal_path`` lazily (checkpoint
+    imports nothing heavy, but runtime/ must not import it at module
+    scope)."""
+    from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+    return ckpt.shard_journal_path(path, shard_index, num_shards)
+
+
 def wait_for_server(address: "tuple[str, int]",
                     timeout_s: float = 30.0) -> bool:
     """Poll until something accepts on ``address`` (or time out)."""

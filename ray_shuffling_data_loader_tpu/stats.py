@@ -672,6 +672,13 @@ TRIAL_FIELDNAMES = [
     # restarts, and consumer-lease expiries.
     "queue_frames_replayed", "queue_server_restarts",
     "queue_lease_expiries",
+    # Serving-plane byte honesty (multiqueue_service v3; process totals
+    # at write time): actual socket payload bytes vs shm-handle
+    # deliveries, the compression win, and the shard count — so a wire
+    # regression is attributable to the serving layer from the CSV
+    # alone, not inferred from end-to-end rates.
+    "queue_bytes_on_wire", "queue_handle_hits", "queue_handle_misses",
+    "queue_compression_ratio", "serve_shards",
 ]
 
 
@@ -701,6 +708,36 @@ def process_recovery_totals() -> Dict[str, int]:
             "rsdl_queue_frames_corrupt_total"),
         "queue_client_reconnects": _counter_total(
             "rsdl_queue_client_reconnects_total"),
+    }
+
+
+def queue_serve_totals() -> Dict[str, Any]:
+    """Serving-plane byte/handle accounting (multiqueue_service v3;
+    monotonic process totals — snapshot before/after a run to attribute
+    a window). ``queue_compression_ratio`` is logical-over-wire for the
+    streamed frames compression actually touched (1.0 = off/no win)."""
+    payload = _counter_total("rsdl_queue_payload_bytes_total")
+    wire = _counter_total("rsdl_queue_bytes_on_wire_total")
+    saved = _counter_total("rsdl_queue_compression_saved_bytes_total")
+    compressed_wire = None
+    ratio = 1.0
+    if saved:
+        # saved = logical - wire over exactly the compressed frames, so
+        # the compressed share of the wire is recoverable from totals
+        # only when every streamed byte was compressed; report the
+        # conservative whole-stream ratio instead.
+        compressed_wire = max(1, wire)
+        ratio = (wire + saved) / compressed_wire
+    return {
+        "queue_payload_bytes": payload,
+        "queue_bytes_on_wire": wire,
+        "queue_handle_hits": _counter_total(
+            "rsdl_queue_handle_hits_total"),
+        "queue_handle_misses": _counter_total(
+            "rsdl_queue_handle_misses_total"),
+        "queue_compression_saved_bytes": saved,
+        "queue_compression_ratio": round(ratio, 4),
+        "serve_shards": int(_counter_total("rsdl_queue_serve_shards")),
     }
 
 EPOCH_FIELDNAMES = [
@@ -782,6 +819,7 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
     wd = watchdog_stats().snapshot()
     fs = fault_stats().snapshot()
     recovery = process_recovery_totals()
+    serve = queue_serve_totals()
     verdict = rt_telemetry.attribution().run_summary() or {}
     verdict_stages = verdict.get("stages", {})
 
@@ -807,6 +845,12 @@ def process_stats(all_stats: List[Tuple[TrialStats, List[Tuple[float, MemorySamp
             row["queue_frames_replayed"] = recovery["queue_frames_replayed"]
             row["queue_server_restarts"] = recovery["queue_server_restarts"]
             row["queue_lease_expiries"] = recovery["queue_lease_expiries"]
+            row["queue_bytes_on_wire"] = serve["queue_bytes_on_wire"]
+            row["queue_handle_hits"] = serve["queue_handle_hits"]
+            row["queue_handle_misses"] = serve["queue_handle_misses"]
+            row["queue_compression_ratio"] = serve[
+                "queue_compression_ratio"]
+            row["serve_shards"] = serve["serve_shards"]
             for stage in rt_telemetry.STAGES:
                 row[f"p95_{stage}_ms"] = verdict_stages.get(
                     stage, {}).get("p95_ms", 0.0)

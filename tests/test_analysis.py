@@ -449,6 +449,37 @@ LINEAGE_PLAN_OK = """
         return plan_ir.queue_index(epoch, rank, num_trainers), host
 """
 
+BYTES_CONCAT_AUG_BAD = """
+    def read_all(sock, n):
+        buf = b""
+        while len(buf) < n:
+            buf += sock.recv(n - len(buf))
+        return buf
+"""
+
+BYTES_CONCAT_REBIND_BAD = """
+    def join_frames(frames):
+        out = bytes()
+        for frame in frames:
+            out = out + frame.payload
+        return out
+"""
+
+BYTES_CONCAT_OK = """
+    def read_all(sock, n):
+        # bytearray accumulates in place; join pays one copy total
+        buf = bytearray()
+        while len(buf) < n:
+            buf += sock.recv(n - len(buf))
+        chunks = []
+        for _ in range(3):
+            chunks.append(sock.recv(n))
+        total = 0
+        for chunk in chunks:
+            total += len(chunk)  # int +=, not a bytes accumulator
+        return bytes(buf) + b"".join(chunks)
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -471,6 +502,8 @@ CASES = [
     ("span-unbalanced", SPAN_NO_FINALLY_BAD, SPAN_BALANCED_OK, {}),
     ("copy-in-hot-path", COPY_HOT_PATH_BAD, COPY_HOT_PATH_OK,
      {"path": "pkg/shuffle.py"}),
+    ("bytes-concat-in-loop", BYTES_CONCAT_AUG_BAD, BYTES_CONCAT_OK, {}),
+    ("bytes-concat-in-loop", BYTES_CONCAT_REBIND_BAD, BYTES_CONCAT_OK, {}),
     ("unregistered-metric", UNREGISTERED_METRIC_BAD, UNREGISTERED_METRIC_OK,
      {"path": "ray_shuffling_data_loader_tpu/multiqueue.py"}),
     ("lineage-outside-plan", LINEAGE_PLAN_ROUTE_BAD, LINEAGE_PLAN_OK,

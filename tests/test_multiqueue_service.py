@@ -1,6 +1,7 @@
 """Tests for the cross-process queue service (multiqueue_service.py):
-loopback protocol, drop-in dataset consumption, failure propagation, and a
-real separate-process trainer rendezvous."""
+loopback protocol, drop-in dataset consumption, failure propagation, a
+real separate-process trainer rendezvous, and the v3 serving plane
+(shm-handle delivery, frame compression, plan-routed shards)."""
 
 import subprocess
 import sys
@@ -12,9 +13,12 @@ import pytest
 from ray_shuffling_data_loader_tpu import data_generation as dg
 from ray_shuffling_data_loader_tpu import multiqueue as mq
 from ray_shuffling_data_loader_tpu import multiqueue_service as svc
+from ray_shuffling_data_loader_tpu import stats as rsdl_stats
 from ray_shuffling_data_loader_tpu.dataset import (ShuffleFailure,
                                                    ShufflingDataset,
+                                                   connect_remote_queue,
                                                    create_batch_queue_and_shuffle)
+from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
 
 
 def test_roundtrip_table_sentinel_failure():
@@ -299,4 +303,213 @@ def test_two_remote_trainer_ranks_drain_their_own_queues(tmp_parquet_dir):
         union = sorted(per_rank[(0, epoch)] + per_rank[(1, epoch)])
         assert union == list(range(300)), f"epoch {epoch} coverage broken"
         assert per_rank[(0, epoch)] and per_rank[(1, epoch)]
+    shuffle_result.result()
+
+
+# ---------------------------------------------------------------------------
+# v3 serving plane: shm-handle delivery, compression, shards
+# ---------------------------------------------------------------------------
+
+
+def test_handle_delivery_cuts_wire_bytes_10x():
+    """Same-host consumers get segment handles, not table bytes: the
+    payload bytes on the wire collapse by >= 10x vs the logical table
+    flow (the acceptance-criteria ratio, asserted at the unit level)."""
+    queue = mq.MultiQueue(1, name=None)
+    table = pa.table({"x": list(range(40_000))})
+    queue.put(0, table)
+    queue.put(0, None)
+    before = rsdl_stats.queue_serve_totals()
+    with svc.serve_queue(queue) as server:
+        with svc.RemoteQueue(server.address) as remote:
+            got = remote.get(0)
+            assert got.equals(table)
+            assert remote.get(0) is None
+    after = rsdl_stats.queue_serve_totals()
+    payload = after["queue_payload_bytes"] - before["queue_payload_bytes"]
+    wire = after["queue_bytes_on_wire"] - before["queue_bytes_on_wire"]
+    hits = after["queue_handle_hits"] - before["queue_handle_hits"]
+    assert hits == 1
+    assert payload > 0 and wire * 10 <= payload, (payload, wire)
+
+
+def test_stream_delivery_forced_still_bit_identical():
+    queue = mq.MultiQueue(1, name=None)
+    table = pa.table({"x": list(range(10_000))})
+    queue.put(0, table)
+    queue.put(0, None)
+    before = rsdl_stats.queue_serve_totals()
+    with svc.serve_queue(queue) as server:
+        with svc.RemoteQueue(server.address, delivery="stream") as remote:
+            assert remote.get(0).equals(table)
+            assert remote.get(0) is None
+    after = rsdl_stats.queue_serve_totals()
+    assert after["queue_handle_hits"] == before["queue_handle_hits"]
+    assert (after["queue_handle_misses"]
+            > before["queue_handle_misses"])
+    # Streamed: every payload byte rides the socket.
+    wire = after["queue_bytes_on_wire"] - before["queue_bytes_on_wire"]
+    payload = after["queue_payload_bytes"] - before["queue_payload_bytes"]
+    assert wire == payload > 0
+
+
+def test_compression_round_trip_and_ratio(monkeypatch):
+    """zlib frame compression (stream delivery): CRC is computed
+    pre-compression, the stream decodes bit-identical, and the saved
+    bytes land in the per-shard counter."""
+    monkeypatch.setenv("RSDL_QUEUE_COMPRESSION", "zlib")
+    monkeypatch.setenv("RSDL_QUEUE_COMPRESSION_MIN_BYTES", "64")
+    queue = mq.MultiQueue(1, name=None)
+    table = pa.table({"x": [42] * 50_000})  # compresses hard
+    queue.put(0, table)
+    queue.put(0, None)
+    before = rsdl_stats.queue_serve_totals()
+    with svc.serve_queue(queue) as server:
+        with svc.RemoteQueue(server.address, delivery="stream") as remote:
+            assert remote.get(0).equals(table)
+            assert remote.get(0) is None
+    after = rsdl_stats.queue_serve_totals()
+    saved = (after["queue_compression_saved_bytes"]
+             - before["queue_compression_saved_bytes"])
+    wire = after["queue_bytes_on_wire"] - before["queue_bytes_on_wire"]
+    payload = after["queue_payload_bytes"] - before["queue_payload_bytes"]
+    assert saved > 0 and wire < payload
+    assert wire + saved == payload
+
+
+def test_compression_with_chaos_corruption_recovers(monkeypatch):
+    """A corrupted COMPRESSED frame is NACK'd and replayed exactly-once:
+    pre-compression CRC keeps the v2 corruption matrix intact."""
+    from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+    monkeypatch.setenv("RSDL_QUEUE_COMPRESSION", "zlib")
+    monkeypatch.setenv("RSDL_QUEUE_COMPRESSION_MIN_BYTES", "64")
+    queue = mq.MultiQueue(1, name=None)
+    for i in range(6):
+        queue.put(0, pa.table({"seq": [i] * 500}))
+    queue.put(0, None)
+    rt_faults.install("frame_corrupt:task0:after2", seed=0)
+    try:
+        with svc.serve_queue(queue) as server:
+            with svc.RemoteQueue(server.address, delivery="stream",
+                                 max_batch=2) as remote:
+                seen = []
+                while True:
+                    item = remote.get(0)
+                    if item is None:
+                        break
+                    seen.append(item.column("seq")[0].as_py())
+        assert seen == list(range(6))
+    finally:
+        rt_faults.clear()
+
+
+def test_handle_downgrade_on_unusable_segment(monkeypatch):
+    """A consumer that cannot map the server's segments NACKs with
+    NACK_NO_HANDLE; the server downgrades the queue to streamed bytes
+    and replays the same frames — delivery degrades, exactly-once does
+    not."""
+    real_read = svc.pp.read_segment_buffer
+    calls = {"n": 0}
+
+    def flaky_read(path):
+        calls["n"] += 1
+        if calls["n"] == 1:  # the CLIENT's first handle open
+            raise OSError("simulated foreign-host segment path")
+        return real_read(path)
+
+    monkeypatch.setattr(svc.pp, "read_segment_buffer", flaky_read)
+    queue = mq.MultiQueue(1, name=None)
+    tables = [pa.table({"seq": [i] * 100}) for i in range(4)]
+    for t in tables:
+        queue.put(0, t)
+    queue.put(0, None)
+    with svc.serve_queue(queue) as server:
+        with svc.RemoteQueue(server.address, delivery="handle",
+                             max_batch=2) as remote:
+            seen = []
+            while True:
+                item = remote.get(0)
+                if item is None:
+                    break
+                seen.append(item.column("seq")[0].as_py())
+    assert seen == [0, 1, 2, 3]
+    assert calls["n"] >= 2  # the server's downgrade re-read happened
+
+
+def test_sharded_server_routes_by_plan_and_rejects_foreign_queues():
+    num_trainers, num_epochs = 2, 2
+    queue = mq.MultiQueue(num_trainers * num_epochs, name=None)
+    for epoch in range(num_epochs):
+        for rank in range(num_trainers):
+            qi = plan_ir.queue_index(epoch, rank, num_trainers)
+            queue.put(qi, pa.table({"v": [qi]}))
+            queue.put(qi, None)
+    with svc.serve_queue_sharded(queue, num_shards=2,
+                                 num_trainers=num_trainers) as sharded:
+        assert sharded.shard_map.num_shards == 2
+        # JSON round trip: what the supervisor hands a trainer process.
+        remote = svc.ShardedRemoteQueue(sharded.shard_map.to_json())
+        for epoch in range(num_epochs):
+            for rank in range(num_trainers):
+                qi = plan_ir.queue_index(epoch, rank, num_trainers)
+                assert remote.get(qi).column("v")[0].as_py() == qi
+                assert remote.get(qi) is None
+        remote.close()
+        # A GET for a queue the shard does not own fails loudly.
+        wrong = svc.RemoteQueue(tuple(sharded.shard_map.addresses[0]))
+        foreign = plan_ir.queue_index(0, 1, num_trainers)  # rank 1
+        got = wrong.get(foreign)
+        assert isinstance(got, ShuffleFailure)
+        assert "not served by shard" in str(got.error)
+        wrong.close()
+
+
+def test_sharded_dataset_consumes_both_ranks(tmp_parquet_dir):
+    """End to end: one shuffle, two trainer ranks, two serving shards —
+    each rank's ShufflingDataset drains through a ShardedRemoteQueue
+    (via connect_remote_queue) and coverage holds per epoch."""
+    filenames, _ = dg.generate_data_local(200, 2, 1, 0.0, tmp_parquet_dir)
+    num_epochs = 2
+    queue, shuffle_result = create_batch_queue_and_shuffle(
+        filenames, num_epochs, num_trainers=2, batch_size=25,
+        max_concurrent_epochs=2, num_reducers=4, seed=21,
+        queue_name="svc-sharded-ds")
+    per_rank: dict = {}
+    errors: list = []
+    with svc.serve_queue_sharded(queue, num_shards=2,
+                                 num_trainers=2) as sharded:
+
+        def consume(rank: int) -> None:
+            try:
+                with connect_remote_queue(sharded.shard_map,
+                                          max_batch=3) as remote:
+                    ds = ShufflingDataset(
+                        filenames, num_epochs, num_trainers=2,
+                        batch_size=25, rank=rank, batch_queue=remote,
+                        shuffle_result=None, seed=21)
+                    for epoch in range(num_epochs):
+                        ds.set_epoch(epoch)
+                        keys = []
+                        for batch in ds:
+                            keys.extend(
+                                batch.column(dg.KEY_COLUMN).to_pylist())
+                        per_rank[(rank, epoch)] = keys
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=consume, args=(r,),
+                                    daemon=True) for r in range(2)]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=120)
+                assert not t.is_alive(), "sharded rank hung"
+        finally:
+            queue.shutdown()
+    if errors:
+        raise errors[0]
+    for epoch in range(num_epochs):
+        union = sorted(per_rank[(0, epoch)] + per_rank[(1, epoch)])
+        assert union == list(range(200)), f"epoch {epoch} coverage broken"
     shuffle_result.result()

@@ -396,3 +396,68 @@ def test_speculative_events_carry_spec_attr_and_skip_attribution():
     from ray_shuffling_data_loader_tpu.runtime import trace as rt_trace
     spans = rt_trace._spans(rt_trace._normalize_in_process(events))
     assert spans == []
+
+
+# ---------------------------------------------------------------------------
+# Serving-plane shard map (PR 10)
+# ---------------------------------------------------------------------------
+
+
+def test_queue_shard_partitions_ranks_exactly_once():
+    num_trainers, num_shards, num_epochs = 5, 3, 4
+    covered = []
+    for shard in range(num_shards):
+        ranks = plan_ir.shard_ranks(shard, num_trainers, num_shards)
+        covered.extend(ranks)
+        # Every epoch of an owned rank routes to the same shard.
+        for rank in ranks:
+            for epoch in range(num_epochs):
+                qi = plan_ir.queue_index(epoch, rank, num_trainers)
+                assert plan_ir.queue_shard(qi, num_trainers,
+                                           num_shards) == shard
+    assert sorted(covered) == list(range(num_trainers))
+
+
+def test_shard_map_round_trip_and_routing():
+    sm = plan_ir.ShardMap(num_trainers=4,
+                          addresses=[("127.0.0.1", 7001),
+                                     ("10.0.0.2", 7002)])
+    sm.validate()
+    clone = plan_ir.ShardMap.from_json(sm.to_json())
+    assert clone == sm
+    assert clone.num_shards == 2
+    qi = plan_ir.queue_index(epoch=3, rank=1, num_trainers=4)
+    assert clone.shard_for_queue(qi) == 1
+    assert clone.address_for_queue(qi) == ("10.0.0.2", 7002)
+    assert clone.ranks_for_shard(0) == [0, 2]
+    assert clone.ranks_for_shard(1) == [1, 3]
+
+
+def test_shard_map_validation_failures():
+    with pytest.raises(plan_ir.PlanError):
+        plan_ir.ShardMap(num_trainers=0,
+                         addresses=[("h", 1)]).validate()
+    with pytest.raises(plan_ir.PlanError):
+        plan_ir.ShardMap(num_trainers=1, addresses=[]).validate()
+    with pytest.raises(plan_ir.PlanError):
+        plan_ir.ShardMap.from_json("[1, 2]")
+
+
+def test_resume_from_watermarks_restricted_to_shard_ranks():
+    """A shard's journal only covers its owned ranks; the resume scan
+    restricted to those ranks must not be dragged to epoch 0 by foreign
+    ranks' absent entries (and must not skip-count foreign queues)."""
+    num_trainers, num_epochs = 2, 3
+    # Rank 1 (shard 1 of 2) fully consumed epoch 0; epoch 1 partial.
+    state = {
+        plan_ir.queue_index(0, 1, num_trainers): {"seq": 4, "done": True},
+        plan_ir.queue_index(1, 1, num_trainers): {"seq": 1,
+                                                  "done": False},
+    }
+    start_all, _ = plan_ir.resume_from_watermarks(state, num_epochs,
+                                                  num_trainers)
+    assert start_all == 0  # rank 0 never consumed anything
+    start, skip = plan_ir.resume_from_watermarks(state, num_epochs,
+                                                 num_trainers, ranks=[1])
+    assert start == 1
+    assert skip == {plan_ir.queue_index(1, 1, num_trainers): 2}

@@ -1,0 +1,220 @@
+"""Per-shard recovery for the sharded serving plane (PR 10).
+
+The PR 5 matrix proved ONE supervised queue-server process recovers a
+``kill -9`` with a bit-identical exactly-once stream. Sharding must not
+dilute that: each shard carries its own watermark journal and restart
+budget, so killing one shard (a) leaves its siblings' streams flowing —
+no cross-shard stall — and (b) recovers its own consumers by supervisor
+restart + journal + lineage regeneration, with the merged multi-rank
+stream still bit-identical to a fault-free run.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import multiqueue_service as svc
+from ray_shuffling_data_loader_tpu import data_generation as dg
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+from ray_shuffling_data_loader_tpu.runtime import supervisor as rt_sup
+from ray_shuffling_data_loader_tpu.shuffle import shuffle as run_shuffle
+
+#: "Never stall past the watchdog threshold": the surviving shard's
+#: per-table waits must stay far below the supervised restart + redial
+#: budget the DEAD shard's consumers legitimately pay.
+SURVIVOR_STALL_BUDGET_S = 15.0
+
+
+def _reference_streams(filenames, epochs, reducers, trainers, seed):
+    """Fault-free per-(rank, epoch) key streams, straight off the
+    deterministic shuffle lineage."""
+    streams: dict = {}
+
+    def consumer(rank, epoch, refs):
+        if refs is not None:
+            streams.setdefault((rank, epoch), []).extend(refs)
+
+    run_shuffle(filenames, consumer, epochs, num_reducers=reducers,
+                num_trainers=trainers, max_concurrent_epochs=1, seed=seed,
+                collect_stats=False, file_cache=None)
+    return {key: [tuple(r.result().column("key").to_pylist())
+                  for r in refs]
+            for key, refs in streams.items()}
+
+
+def test_shard_kill9_survivors_flow_and_merged_stream_bit_identical(
+        tmp_parquet_dir):
+    """kill -9 one shard mid-epoch: the surviving shard's rank drains
+    its whole run without stalling past the watchdog budget while the
+    dead shard restarts; the dead shard's consumer reconnects and
+    replays exactly-once; the merged stream is bit-identical to the
+    fault-free run."""
+    trainers, epochs, reducers, seed = 2, 2, 4, 9
+    filenames, _ = dg.generate_data_local(600, 2, 1, 0.0, tmp_parquet_dir)
+    expected = _reference_streams(filenames, epochs, reducers, trainers,
+                                  seed)
+
+    supervisors, shard_map = rt_sup.launch_supervised_queue_shards(dict(
+        filenames=filenames, num_epochs=epochs, num_trainers=trainers,
+        num_reducers=reducers, seed=seed, max_concurrent_epochs=1,
+        journal_path=os.path.join(tmp_parquet_dir, "watermarks.wal"),
+        file_cache=None), num_shards=2)
+    assert shard_map.num_shards == 2
+    # Rank r is served by shard r (queue_shard placement, 2 shards).
+    assert shard_map.shard_for_rank(0) == 0
+    assert shard_map.shard_for_rank(1) == 1
+
+    got: dict = {}
+    errors: list = []
+    killed = threading.Event()
+    survivor_max_wait = {"s": 0.0}
+
+    def consume(rank):
+        try:
+            remote = svc.ShardedRemoteQueue(shard_map, retries=12,
+                                            max_batch=2)
+            ds = ShufflingDataset(filenames, epochs,
+                                  num_trainers=trainers, batch_size=50,
+                                  rank=rank, batch_queue=remote,
+                                  shuffle_result=None, seed=seed)
+            try:
+                for epoch in range(epochs):
+                    ds.set_epoch(epoch)
+                    tables = []
+                    for table in _timed_tables(ds, rank, tables):
+                        tables.append(
+                            tuple(table.column("key").to_pylist()))
+                    got[(rank, epoch)] = tables
+            finally:
+                remote.close()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    def _timed_tables(ds, rank, tables):
+        for_iter = ds.iter_tables()
+        while True:
+            start = time.monotonic()
+            try:
+                table = next(for_iter)
+            except StopIteration:
+                return
+            waited = time.monotonic() - start
+            if rank == 1 and killed.is_set():
+                # The SURVIVING shard's stream, measured only while its
+                # sibling is (or was just) dead.
+                survivor_max_wait["s"] = max(survivor_max_wait["s"],
+                                             waited)
+            yield table
+            if rank == 0 and not killed.is_set() and len(tables) >= 1:
+                # Mid-epoch, after the first table of rank 0's stream:
+                # a real SIGKILL of rank 0's shard process.
+                os.kill(supervisors[0].pid, signal.SIGKILL)
+                killed.set()
+
+    try:
+        for address in shard_map.addresses:
+            assert rt_sup.wait_for_server(tuple(address), timeout_s=60)
+        # Rank 1 starts only once the kill landed, so every one of its
+        # waits is measured against a world with a dead sibling shard.
+        rank0 = threading.Thread(target=consume, args=(0,), daemon=True)
+        rank0.start()
+        assert killed.wait(timeout=120), "kill point never reached"
+        rank1 = threading.Thread(target=consume, args=(1,), daemon=True)
+        rank1.start()
+        for thread in (rank0, rank1):
+            thread.join(timeout=180)
+            assert not thread.is_alive(), "consumer hung"
+    finally:
+        for supervisor in supervisors:
+            supervisor.stop()
+    if errors:
+        raise errors[0]
+
+    # (a) The dead shard really died and was really restarted; its
+    # sibling never was.
+    assert supervisors[0].restarts >= 1
+    assert supervisors[1].restarts == 0
+    # (b) The surviving shard's stream never stalled past the budget.
+    assert survivor_max_wait["s"] < SURVIVOR_STALL_BUDGET_S, \
+        survivor_max_wait
+    # (c) Exactly-once, bit-identical: every rank's every epoch equals
+    # the fault-free lineage run — list equality catches loss,
+    # duplication and reordering at once, across BOTH shards.
+    assert got == expected, {
+        key: (len(got.get(key, [])), len(expected[key]))
+        for key in expected}
+
+
+def test_shard_journals_are_disjoint_and_resume_restricted(tmp_path):
+    """Each shard journals only its owned ranks' queues, and the resume
+    query restricted to those ranks plans from the shard's own progress
+    (a foreign rank's absent entries cannot reset it to epoch 0)."""
+    from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+
+    trainers, epochs = 2, 2
+    base = str(tmp_path / "wm.wal")
+    paths = [ckpt.shard_journal_path(base, s, 2) for s in range(2)]
+    assert paths[0] != paths[1]
+    # Shard 1 (rank 1) finished epoch 0 (2 tables + sentinel = seqs
+    # 0..2) and nothing else; shard 0 journaled nothing.
+    journal = ckpt.WatermarkJournal(paths[1])
+    journal.record(plan_ir.queue_index(0, 1, trainers), 2, 100,
+                   done=True)
+    journal.close()
+    state = ckpt.WatermarkJournal.load(paths[1])
+    start, skip = plan_ir.resume_from_watermarks(
+        state, epochs, trainers,
+        ranks=plan_ir.shard_ranks(1, trainers, 2))
+    assert start == 1
+    assert skip == {}
+    # The unrestricted scan would restart from epoch 0 — exactly the
+    # cross-shard coupling the per-shard journals exist to avoid.
+    start_all, _ = plan_ir.resume_from_watermarks(state, epochs, trainers)
+    assert start_all == 0
+
+
+@pytest.mark.slow
+def test_shard_kill9_repeated_across_epochs(tmp_parquet_dir):
+    """Slow soak: kill the same shard in BOTH epochs; the journal +
+    lineage regeneration recovers each time and the merged stream stays
+    bit-identical."""
+    trainers, epochs, reducers, seed = 2, 2, 4, 17
+    filenames, _ = dg.generate_data_local(1_200, 2, 1, 0.0,
+                                          tmp_parquet_dir)
+    expected = _reference_streams(filenames, epochs, reducers, trainers,
+                                  seed)
+    supervisors, shard_map = rt_sup.launch_supervised_queue_shards(dict(
+        filenames=filenames, num_epochs=epochs, num_trainers=trainers,
+        num_reducers=reducers, seed=seed, max_concurrent_epochs=1,
+        journal_path=os.path.join(tmp_parquet_dir, "wm-soak.wal"),
+        file_cache=None), num_shards=2)
+    got: dict = {}
+    try:
+        for address in shard_map.addresses:
+            assert rt_sup.wait_for_server(tuple(address), timeout_s=60)
+        remote = svc.ShardedRemoteQueue(shard_map, retries=12,
+                                        max_batch=2)
+        ds = ShufflingDataset(filenames, epochs, num_trainers=trainers,
+                              batch_size=50, rank=0, batch_queue=remote,
+                              shuffle_result=None, seed=seed)
+        kills = {(0, 1), (1, 1)}  # (epoch, tables-seen) kill points
+        for epoch in range(epochs):
+            ds.set_epoch(epoch)
+            tables = []
+            for table in ds.iter_tables():
+                tables.append(tuple(table.column("key").to_pylist()))
+                if (epoch, len(tables)) in kills:
+                    os.kill(supervisors[0].pid, signal.SIGKILL)
+            got[(0, epoch)] = tables
+        remote.close()
+    finally:
+        for supervisor in supervisors:
+            supervisor.stop()
+    assert supervisors[0].restarts >= 2
+    rank0_expected = {k: v for k, v in expected.items() if k[0] == 0}
+    rank0_got = {k: v for k, v in got.items() if k[0] == 0}
+    assert rank0_got == rank0_expected

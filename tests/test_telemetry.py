@@ -225,9 +225,27 @@ def test_rsdl_top_renders_from_exposition(tmp_path):
     spec.loader.exec_module(rsdl_top)
     metrics.histogram("rsdl_stage_seconds", "s",
                       stage="reduce").observe(0.02)
+    # Serving-plane shard line (multiqueue_service v3 per-shard series).
+    metrics.gauge("rsdl_queue_shard_depth", "d", shard="0").set(4)
+    metrics.counter("rsdl_queue_handle_hits_total", "h", shard="0").inc(9)
+    metrics.counter("rsdl_queue_handle_misses_total", "m",
+                    shard="0").inc(1)
+    metrics.counter("rsdl_queue_bytes_on_wire_total", "w",
+                    shard="0").inc(2048)
     path = metrics.write_file(str(tmp_path / "m.prom"))
-    table = rsdl_top.render(rsdl_top.read_exposition(file=path))
+    parsed = rsdl_top.read_exposition(file=path)
+    table = rsdl_top.render(parsed)
     assert "reduce" in table
+    # Per-shard serving-plane line: present, with the hit share computed
+    # from the SAME exposition (the process registry is shared across
+    # tests, so the absolute counts here are cumulative, not ours).
+    hits = rsdl_top._by_label(parsed, "rsdl_queue_handle_hits_total",
+                              "shard")["0"]
+    misses = rsdl_top._by_label(parsed, "rsdl_queue_handle_misses_total",
+                                "shard")["0"]
+    expect_pct = 100.0 * hits / (hits + misses)
+    assert "shard 0" in table
+    assert f"handle-hit {expect_pct:5.1f}%" in table
     assert rsdl_top.main([f"--file={path}", "--once"]) == 0
 
 

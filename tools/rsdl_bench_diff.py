@@ -54,6 +54,17 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
      "slack": 2.0},
     {"key": "fill_s", "mode": "higher_bad", "pct": 50.0, "slack": 1.0},
     {"key": "telemetry_overhead_pct", "mode": "ceiling", "limit": 1.0},
+    # Serving-plane leg (multiqueue_service v3): aggregate remote-stream
+    # throughput on the sharded fabric, and the shard-scaling ratio
+    # itself — a shard-placement regression can keep absolute rows/s
+    # afloat on a faster host while the scaling evidence collapses.
+    {"key": "serve_rows_per_sec", "mode": "lower_bad", "pct": 10.0},
+    {"key": "serve_speedup_vs_single_shard", "mode": "lower_bad",
+     "pct": 15.0},
+    # Handle delivery must keep beating v2 streaming on wire bytes by a
+    # wide margin (the >= 10x acceptance ratio, with noise headroom).
+    {"key": "serve_handle_wire_reduction_x", "mode": "lower_bad",
+     "pct": 50.0},
 ]
 
 

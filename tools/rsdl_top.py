@@ -158,6 +158,52 @@ def _scalar(parsed: dict, name: str) -> float:
     return sum(parsed.get(name, {}).values())
 
 
+def _by_label(parsed: dict, name: str, label: str) -> dict:
+    """{label_value: summed value} for one metric's samples."""
+    out = {}
+    for labels, value in parsed.get(name, {}).items():
+        key = dict(labels).get(label)
+        if key is not None:
+            out[key] = out.get(key, 0.0) + value
+    return out
+
+
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024 or unit == "TB":
+            return f"{n:.0f} {unit}" if unit == "B" else f"{n:.1f} {unit}"
+        n /= 1024.0
+    return f"{n:.1f} TB"
+
+
+def render_shards(parsed: dict) -> list:
+    """One line per serving-plane shard (multiqueue_service v3): queue
+    depth, handle-hit share of table frames, actual wire bytes, and the
+    compression saving — the per-shard federated view the shard map
+    spreads across processes."""
+    depth = _by_label(parsed, "rsdl_queue_shard_depth", "shard")
+    hits = _by_label(parsed, "rsdl_queue_handle_hits_total", "shard")
+    misses = _by_label(parsed, "rsdl_queue_handle_misses_total", "shard")
+    wire = _by_label(parsed, "rsdl_queue_bytes_on_wire_total", "shard")
+    saved = _by_label(parsed, "rsdl_queue_compression_saved_bytes_total",
+                      "shard")
+    shards = sorted(set(depth) | set(hits) | set(misses) | set(wire),
+                    key=lambda s: (len(s), s))
+    if not shards:
+        return []
+    lines = ["serving shards:"]
+    for shard in shards:
+        h, m = hits.get(shard, 0.0), misses.get(shard, 0.0)
+        hit_pct = 100.0 * h / (h + m) if h + m else 0.0
+        line = (f"  shard {shard}: depth {int(depth.get(shard, 0)):>5}  "
+                f"handle-hit {hit_pct:5.1f}%  "
+                f"wire {_human_bytes(wire.get(shard, 0.0)):>10}")
+        if saved.get(shard):
+            line += f"  saved {_human_bytes(saved[shard])}"
+        lines.append(line)
+    return lines
+
+
 def render(parsed: dict, before: dict = None, interval_s: float = None
            ) -> str:
     """One table: per-stage events/s (or totals), busy share, p95."""
@@ -218,6 +264,7 @@ def render(parsed: dict, before: dict = None, interval_s: float = None
             f"dead (lease expired): {int(expiries)}   "
             f"frames replayed: {int(replayed)}   "
             f"server restarts: {int(restarts)}")
+    lines.extend(render_shards(parsed))
     # Critical-path line (runtime/trace.py gauges, refreshed per epoch):
     # the top-3 stages by critical-path self time plus the current
     # straggler task — the "what do I optimize" one-liner.
