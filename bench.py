@@ -1195,6 +1195,157 @@ def _run_serve_leg(filenames, seed: int = 0,
     }
 
 
+def _run_latency_leg(filenames, seed: int = 0,
+                     trainer_streams: int = 2,
+                     shards: int = 2) -> dict:
+    """Delivery-latency leg (runtime/latency.py): ``trainer_streams``
+    remote trainers drain one pre-shuffled epoch over the SHARDED
+    serving plane, each closing the loop through a real
+    ``JaxShufflingDataset`` (convert + device transfer), on BOTH
+    delivery paths — shm-handle first, then streamed v2 bytes. The
+    sketch's centroid deltas between snapshots attribute the quantiles
+    per path exactly (cumulative counts subtract), and the headline
+    ``delivery_p99_ms`` / ``freshness_p99_ms`` are gated by
+    ``--baseline`` like any other metric.
+    """
+    import threading
+
+    from ray_shuffling_data_loader_tpu import multiqueue as mq
+    from ray_shuffling_data_loader_tpu import multiqueue_service as svc
+    from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+    from ray_shuffling_data_loader_tpu.runtime import latency as rt_lat
+    from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+    from ray_shuffling_data_loader_tpu.shuffle import shuffle as run_shuffle
+    from ray_shuffling_data_loader_tpu.workloads.dlrm_criteo import dlrm_spec
+
+    leg_files = filenames[:2]
+    series = "rsdl_delivery_latency_seconds_centroid"
+
+    def _snapshot() -> dict:
+        return dict(rt_metrics.parse_exposition(
+            rt_metrics.render()).get(series, {}))
+
+    def _delta(now: dict, base: dict) -> dict:
+        return {labels: value - base.get(labels, 0.0)
+                for labels, value in now.items()
+                if value - base.get(labels, 0.0) > 0}
+
+    def _hop_stats(delta: dict, hop: str):
+        counts: dict = {}
+        for labels, value in delta.items():
+            d = dict(labels)
+            if d.get("hop") != hop or "c" not in d:
+                continue
+            centroid = float(d["c"])
+            counts[centroid] = counts.get(centroid, 0.0) + value
+        total = int(sum(counts.values()))
+        if not total:
+            return None
+        return {
+            "count": total,
+            "p50": rt_metrics._centroid_quantile(counts, total, 0.5),
+            "p95": rt_metrics._centroid_quantile(counts, total, 0.95),
+            "p99": rt_metrics._centroid_quantile(counts, total, 0.99),
+        }
+
+    def _drain(delivery: str) -> None:
+        queue = mq.MultiQueue(trainer_streams)
+
+        def consumer(rank, epoch, refs):
+            queue_idx = plan_ir.queue_index(epoch, rank, trainer_streams)
+            if refs is None:
+                queue.put(queue_idx, None)
+            else:
+                queue.put_batch(queue_idx, list(refs))
+
+        run_shuffle(leg_files, consumer, 1,
+                    num_reducers=trainer_streams,
+                    num_trainers=trainer_streams, max_concurrent_epochs=1,
+                    seed=seed, collect_stats=False, file_cache=None)
+        errors: list = []
+        with svc.serve_queue_sharded(queue, num_shards=shards,
+                                     num_trainers=trainer_streams
+                                     ) as sharded:
+
+            def consume(rank: int) -> None:
+                try:
+                    remote = svc.ShardedRemoteQueue(
+                        sharded.shard_map, max_batch=2, delivery=delivery)
+                    # Small batches + drop_last=False: the leg measures
+                    # latency, not throughput, and must convert/transfer
+                    # even a smoke-sized corpus so the device hops have
+                    # samples.
+                    ds = JaxShufflingDataset(
+                        leg_files, num_epochs=1,
+                        num_trainers=trainer_streams, batch_size=8_192,
+                        rank=rank, batch_queue=remote,
+                        shuffle_result=None, seed=seed, prefetch_size=2,
+                        drop_last=False, **dlrm_spec())
+                    try:
+                        ds.set_epoch(0)
+                        for _features, _label in ds:
+                            pass
+                    finally:
+                        ds.close()
+                        remote.close()
+                except BaseException as e:  # noqa: BLE001 - re-raised
+                    errors.append(e)
+
+            threads = [threading.Thread(target=consume, args=(rank,),
+                                        daemon=True,
+                                        name=f"bench-latency-{rank}")
+                       for rank in range(trainer_streams)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=300)
+        queue.shutdown()
+        if errors:
+            raise errors[0]
+
+    before = _snapshot()
+    _drain("auto")       # shm-handle path (loopback)
+    after_handle = _snapshot()
+    _drain("stream")     # streamed v2 bytes, same table flow
+    after_stream = _snapshot()
+
+    handle_delta = _delta(after_handle, before)
+    stream_delta = _delta(after_stream, after_handle)
+    whole_delta = _delta(after_stream, before)
+    delivered = _hop_stats(handle_delta, rt_lat.HOP_BIRTH_TO_DELIVERED)
+    delivered_stream = _hop_stats(stream_delta,
+                                  rt_lat.HOP_BIRTH_TO_DELIVERED)
+    device = _hop_stats(whole_delta, rt_lat.HOP_BIRTH_TO_DEVICE)
+    queued = _hop_stats(whole_delta, rt_lat.HOP_BIRTH_TO_QUEUED)
+    if delivered is None or delivered_stream is None:
+        raise RuntimeError(
+            "latency leg observed no birth_to_delivered samples on one "
+            "of the delivery paths (handle "
+            f"{delivered}, stream {delivered_stream})")
+    per_queue = {
+        dict(labels).get("queue", "?"): round(entry["p99"] * 1e3, 3)
+        for labels, entry in rt_metrics.sketch_quantiles(
+            {series: whole_delta}, "rsdl_delivery_latency_seconds",
+            qs=(0.99,), hop=rt_lat.HOP_BIRTH_TO_DELIVERED).items()}
+    result = {
+        "latency_trainer_streams": trainer_streams,
+        "latency_shards": shards,
+        "delivery_p50_ms": round(delivered["p50"] * 1e3, 3),
+        "delivery_p95_ms": round(delivered["p95"] * 1e3, 3),
+        "delivery_p99_ms": round(delivered["p99"] * 1e3, 3),
+        "delivery_p99_ms_stream": round(
+            delivered_stream["p99"] * 1e3, 3),
+        "delivery_frames": delivered["count"] + delivered_stream["count"],
+        "latency_per_queue_p99_ms": per_queue,
+    }
+    if queued is not None:
+        result["queued_p99_ms"] = round(queued["p99"] * 1e3, 3)
+    if device is not None:
+        result["freshness_p99_ms"] = round(device["p99"] * 1e3, 3)
+    return result
+
+
 def main() -> None:
     if os.environ.get("RSDL_BENCH_CPU"):
         os.environ.setdefault(
@@ -1304,7 +1455,8 @@ def main() -> None:
     step_ms = float(os.environ.get("RSDL_BENCH_STEP_MS", 0))
 
     phases = [p.strip() for p in os.environ.get(
-        "RSDL_BENCH_PHASES", "cached,cold,train,scaling,serve").split(",")
+        "RSDL_BENCH_PHASES",
+        "cached,cold,train,scaling,serve,latency").split(",")
         if p.strip()]
     if os.environ.get("RSDL_BENCH_COLD"):
         # Legacy knob: the cold regime IS the headline; skip cached.
@@ -1342,7 +1494,7 @@ def main() -> None:
     fs_before = rsdl_stats.fault_stats().snapshot()
     recovery_before = rsdl_stats.process_recovery_totals()
 
-    cached = cold = train = train_agg = scaling = serve = None
+    cached = cold = train = train_agg = scaling = serve = latency = None
 
     def _phase(name, fn):
         """Run one phase; a failed phase is reported and OMITTED from the
@@ -1384,6 +1536,13 @@ def main() -> None:
     # --baseline — fails the invocation like any other regression.
     health_by_phase = {}
 
+    # delivery_latency_breach / freshness_stall are deliberately NOT
+    # armed here: the ingest/train phases pre-produce whole epochs
+    # (max_concurrent_epochs=2), so tables dwell in the queue for tens
+    # of seconds BY DESIGN — birth->delivered there measures buffer
+    # depth, not serving health. The delivery SLOs are judged where
+    # they mean something (the serving plane); the bench's latency leg
+    # reports the p99s and --baseline gates them.
     def _armed_phase(name, fn, with_stall=False):
         detectors = [d for d in ("throughput_droop", "stall_breach",
                                  "ledger_creep", "queue_saturation",
@@ -1444,6 +1603,16 @@ def main() -> None:
                       f"({serve['serve_speedup_vs_single_shard']}x of 1 "
                       f"shard); handle delivery cut wire bytes "
                       f"{serve['serve_handle_wire_reduction_x']}x",
+                      file=sys.stderr)
+        if "latency" in phases:
+            latency = _phase("latency", lambda: _run_latency_leg(filenames))
+            if latency is not None:
+                print(f"# latency: delivery p99 "
+                      f"{latency['delivery_p99_ms']}ms (handle) / "
+                      f"{latency['delivery_p99_ms_stream']}ms (stream) "
+                      f"over {latency['delivery_frames']} frames on "
+                      f"{latency['latency_shards']} shards; freshness "
+                      f"p99 {latency.get('freshness_p99_ms', 'n/a')}ms",
                       file=sys.stderr)
         if "train" in phases:
             train_epochs = int(os.environ.get("RSDL_BENCH_TRAIN_EPOCHS", 4))
@@ -1543,6 +1712,16 @@ def main() -> None:
                     "wait_mean_ms": 0.0, "timed_epochs": 1,
                     "duration_s": 0.0}
         metric = "serve_rows_per_sec_aggregate"
+    elif latency is not None:
+        # Latency-only run (RSDL_BENCH_PHASES=latency): the headline is
+        # the end-to-end delivery p99 itself (note the unit: ms, and
+        # LOWER is better — the bench-diff `value` rule judges the
+        # metric-specific key `delivery_p99_ms` instead).
+        headline = {"rows_per_s": latency["delivery_p99_ms"],
+                    "stall_pct": 0.0, "stall_s": 0.0,
+                    "wait_mean_ms": 0.0, "timed_epochs": 1,
+                    "duration_s": 0.0}
+        metric = "delivery_p99_ms"
     else:
         print(f"no phase produced a result (selected: {phases!r}; a "
               "'# <name> phase FAILED' line above means the phase ran "
@@ -1563,7 +1742,7 @@ def main() -> None:
     record = {
         "metric": metric,
         "value": round(headline["rows_per_s"], 1),
-        "unit": "rows/s",
+        "unit": "ms" if metric == "delivery_p99_ms" else "rows/s",
         "vs_baseline": (round(vs_baseline, 3)
                         if vs_baseline is not None else None),
         # Headline-phase stall stats (near-zero consumer: stall% ~= 100%
@@ -1608,6 +1787,12 @@ def main() -> None:
         # Worker-count scaling leg (1 -> N): near-linear scaling must be
         # an artifact in the record, not a claim in prose.
         record["worker_scaling"] = scaling
+    if latency is not None:
+        # Delivery-latency leg (runtime/latency.py): flat keys so the
+        # bench-diff gate reads delivery_p99_ms / freshness_p99_ms like
+        # any other metric — the observability prerequisite ROADMAP
+        # items 2 and 5 consume ("bounded p99 delivery latency").
+        record.update(latency)
     if serve is not None:
         # Serving-plane leg (multiqueue_service v3): flat keys so the
         # bench-diff gate and the trial CSV read them like any other

@@ -56,6 +56,15 @@ if [ "${RSDL_MICROBENCH:-1}" != "0" ]; then
     fi
 fi
 
+# Delivery-latency sketch self-test (tools/rsdl_top.py, stdlib-only):
+# observes disjoint values in two registries, merges them through the
+# shard-federation path, and requires the merged quantiles to equal a
+# directly-merged sketch's — a schema drift in the sketch exposition
+# (series suffix, centroid label, merge math) fails here, not in a
+# silently-wrong p99 on a dashboard.
+echo "-- rsdl-top (check-latency mode)"
+python tools/rsdl_top.py --check-latency >/dev/null
+
 # Bench regression check (tools/rsdl_bench_diff.py, stdlib-only): when
 # committed bench records are present, compare the two newest and print
 # the per-metric verdict. Check mode is informational (rc 0) — the hard

@@ -17,6 +17,7 @@ half the reference ecosystem is missing.
 
 from __future__ import annotations
 
+import collections
 import dataclasses
 import json
 import os
@@ -81,12 +82,21 @@ class LoaderCheckpoint:
 @dataclasses.dataclass
 class WatermarkEntry:
     """Latest journaled state of one queue index: the last acked frame
-    seq, cumulative table rows delivered through it, and whether the
-    epoch-end sentinel itself has been acked."""
+    seq, cumulative table rows delivered through it, whether the
+    epoch-end sentinel itself has been acked, and the journaled birth
+    stamps of still-unacked frames.
+
+    ``births`` maps frame seq -> ``(pid, t_mono, t_unix)``: the frame's
+    ORIGINAL payload birth (runtime/latency.py stamp), journaled when
+    the frame was first built. A queue index never existed -> entry
+    with ``seq == -1`` (nothing delivered) carrying only births — the
+    restored server's ``next_seq`` math (``seq + 1``) and the resume
+    query's ``skip_items`` (``seq + 1``) both read that as zero."""
 
     seq: int
     rows: int
     done: bool = False
+    births: Dict[int, tuple] = dataclasses.field(default_factory=dict)
 
 
 def shard_journal_path(path: str, shard_index: int, num_shards: int) -> str:
@@ -135,6 +145,22 @@ class WatermarkJournal:
         (replay is idempotent by seq)."""
         entry = {"q": int(queue_index), "seq": int(seq),
                  "rows": int(rows), "done": bool(done)}
+        self._append(entry, durable=True)
+
+    def record_birth(self, queue_index: int, seq: int, pid: int,
+                     t_mono: float, t_unix: float) -> None:
+        """Journal a frame's ORIGINAL payload birth when the frame is
+        first built, so a restarted server regenerating the undelivered
+        remainder re-attaches the original stamps and a kill -9 replay
+        reports its true (crash-spanning) delivery latency instead of a
+        laundered recompute-fresh one. Flushed but NOT fsync'd: losing
+        a tail birth record merely under-reports one frame's latency
+        (the regenerated stamp takes over) — never correctness."""
+        self._append({"q": int(queue_index), "bseq": int(seq),
+                      "pid": int(pid), "tm": float(t_mono),
+                      "tu": float(t_unix)}, durable=False)
+
+    def _append(self, entry: dict, durable: bool) -> None:
         line = self._encode(entry) + "\n"
         with self._lock:
             if self._file is None:
@@ -143,13 +169,16 @@ class WatermarkJournal:
                 self._file = open(self._path, "a", encoding="utf-8")
             self._file.write(line)
             self._file.flush()
-            os.fsync(self._file.fileno())
+            if durable:
+                os.fsync(self._file.fileno())
 
     @classmethod
     def load(cls, path: str) -> Dict[int, WatermarkEntry]:
         """Latest watermark per queue index; lines with a bad/missing
         CRC (torn tail) are skipped with a warning."""
         state: Dict[int, WatermarkEntry] = {}
+        births: Dict[int, Dict[int, tuple]] = \
+            collections.defaultdict(dict)
         if not os.path.exists(path):
             return state
         with open(path, encoding="utf-8") as f:
@@ -166,6 +195,15 @@ class WatermarkJournal:
                             record["crc"]:
                         raise ValueError("crc mismatch")
                     queue_index = int(entry["q"])
+                    if "bseq" in entry:
+                        # Frame-birth record: retained only while its
+                        # seq is past the queue's watermark (acked
+                        # frames never replay, so their births are
+                        # dead weight).
+                        births[queue_index][int(entry["bseq"])] = (
+                            int(entry["pid"]), float(entry["tm"]),
+                            float(entry["tu"]))
+                        continue
                 except (ValueError, KeyError, TypeError) as e:
                     logger.warning(
                         "watermark journal %s line %d unreadable (%s); "
@@ -177,6 +215,12 @@ class WatermarkJournal:
                     state[queue_index] = WatermarkEntry(
                         seq=int(entry["seq"]), rows=int(entry["rows"]),
                         done=bool(entry["done"]))
+        for queue_index, stamps in births.items():
+            entry = state.get(queue_index)
+            if entry is None:
+                entry = state[queue_index] = WatermarkEntry(seq=-1, rows=0)
+            entry.births = {seq: stamp for seq, stamp in stamps.items()
+                            if seq > entry.seq}
         return state
 
     def resume_plan(self, num_epochs: int, num_trainers: int
@@ -207,10 +251,20 @@ class WatermarkJournal:
                 with os.fdopen(fd, "w") as f:
                     for queue_index in sorted(state):
                         entry = state[queue_index]
-                        f.write(self._encode(
-                            {"q": queue_index, "seq": entry.seq,
-                             "rows": entry.rows, "done": entry.done})
-                            + "\n")
+                        if entry.seq >= 0:
+                            f.write(self._encode(
+                                {"q": queue_index, "seq": entry.seq,
+                                 "rows": entry.rows, "done": entry.done})
+                                + "\n")
+                        # Unacked frames' birth stamps survive
+                        # compaction — they are exactly the frames a
+                        # restart will regenerate and re-stamp from.
+                        for seq in sorted(entry.births):
+                            pid, t_mono, t_unix = entry.births[seq]
+                            f.write(self._encode(
+                                {"q": queue_index, "bseq": seq,
+                                 "pid": pid, "tm": t_mono,
+                                 "tu": t_unix}) + "\n")
                     f.flush()
                     os.fsync(f.fileno())
                 os.replace(tmp_path, self._path)

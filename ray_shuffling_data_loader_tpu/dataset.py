@@ -36,6 +36,7 @@ from ray_shuffling_data_loader_tpu import spill
 # import resolves differently under ``python -m`` than under package import.
 sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
 from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+from ray_shuffling_data_loader_tpu.runtime import latency as rt_latency
 from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.utils.config import default_num_reducers
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
@@ -263,6 +264,15 @@ class ShufflingDataset:
         # (reference: dataset.py:143-168).
         self._last_epoch: Optional[int] = None
         self._drop_last = drop_last
+        # Delivery-latency plane (runtime/latency.py): the end-to-end
+        # birth->delivered hop is observed HERE for in-process queues
+        # (reducer output metadata -> consumer hand-off). Remote queue
+        # clients see the wire stamps first and observe it themselves —
+        # their `observes_delivery` marker keeps the hop single-counted.
+        self._lat_observe = not getattr(self._batch_queue,
+                                        "observes_delivery", False)
+        self._lat_queue = str(rank)
+        self._lat_anchors = rt_latency.ClockAnchors()
 
     @property
     def batch_size(self) -> int:
@@ -376,6 +386,16 @@ class ShufflingDataset:
                     to_skip -= raw.num_rows
                 continue
             table: pa.Table = spill.unwrap(raw)
+            if self._lat_observe:
+                meta = table.schema.metadata
+                birth = rt_latency.parse_stamp(
+                    meta.get(rt_latency.BIRTH_META_KEY) if meta else None)
+                if birth is not None:
+                    age = self._lat_anchors.latency_s(birth)
+                    rt_latency.observe_hop(
+                        rt_latency.HOP_BIRTH_TO_DELIVERED,
+                        self._lat_queue, age)
+                    rt_latency.set_freshness(self._lat_queue, age)
             if to_skip:
                 table = table.slice(to_skip)
                 to_skip = 0

@@ -35,6 +35,7 @@ import pyarrow as pa
 from ray_shuffling_data_loader_tpu.dataset import (ShufflingDataset,
                                                    slice_batches)
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+from ray_shuffling_data_loader_tpu.runtime import latency as rt_latency
 from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
 from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
 from ray_shuffling_data_loader_tpu.stats import BatchWaitStats
@@ -290,6 +291,11 @@ class _BatchConverter:
         self._transfer_retry = rt_retry.RetryPolicy.for_component(
             "jax_dataset", retryable=rt_retry.transient_retryable)
         self._transfer_seq = 0  # producer-thread-only; keys chaos draws
+        # Delivery-latency probe (runtime/latency.py), installed by the
+        # owning JaxShufflingDataset: convert() notes the source table's
+        # birth, transfer completions close the delivered->device and
+        # birth->device hops. None = no probe (direct converter use).
+        self.latency_probe = None
 
     def _device_put_retried(self, thunk):
         """One (bulk or per-batch) device_put: named fault site + bounded
@@ -317,6 +323,14 @@ class _BatchConverter:
 
         return self._transfer_retry.call(_put, describe="device_put",
                                          on_recovery=_recovered)
+
+    def _note_device_done(self) -> None:
+        """One device-transfer completion on the latency plane (no-op
+        without a probe). ``device_put`` is async — the span closed here
+        is dispatch-complete, the same boundary the ``device_transfer``
+        telemetry stage measures."""
+        if self.latency_probe is not None:
+            self.latency_probe.device_done()
 
     def _on_bulk_stall(self, report) -> None:
         """Watchdog escalation hook — runs on the MONITOR thread (the
@@ -347,6 +361,8 @@ class _BatchConverter:
             self._mesh, P(self._data_axis, *([None] * (ndim - 1))))
 
     def convert(self, table: pa.Table):
+        if self.latency_probe is not None:
+            self.latency_probe.table_arrived(table)
         return convert_to_arrays(
             table, self._feature_columns, self._feature_shapes,
             self._feature_types, self._label_column, self._label_shape,
@@ -366,6 +382,7 @@ class _BatchConverter:
             if self._stack_features:
                 features = (features[0] if len(features) == 1
                             else np.concatenate(features, axis=1))
+            self._note_device_done()
             return features, label
         # ONE device_put for the whole batch pytree: the runtime batches
         # the per-column copies into a single transfer (through the PJRT
@@ -389,6 +406,7 @@ class _BatchConverter:
                     self._device_concat = jax.jit(
                         lambda cols: jnp.concatenate(cols, axis=1))
                 out_features = self._device_concat(out_features)
+        self._note_device_done()
         return out_features, out_label
 
     def transfer_table(self, arrays_label, n_batches: int, batch_size: int):
@@ -409,10 +427,13 @@ class _BatchConverter:
         import jax
         features, label = arrays_label
         if not self._device_put:
+            self._note_device_done()
             return features, label
         if self._mesh is None:
-            return self._device_put_retried(
+            item = self._device_put_retried(
                 lambda: jax.device_put((features, label)))
+            self._note_device_done()
+            return item
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         def chunked(a):
@@ -425,10 +446,12 @@ class _BatchConverter:
 
         features = [chunked(f) for f in features]
         label = chunked(label)
-        return self._device_put_retried(
+        item = self._device_put_retried(
             lambda: jax.device_put(
                 (features, label),
                 ([sharding(f) for f in features], sharding(label))))
+        self._note_device_done()
+        return item
 
     def slice_batch(self, dev_table, batch_index: int, batch_size: int):
         """Carve batch ``batch_index`` out of a bulk device chunk: one
@@ -949,6 +972,12 @@ class JaxShufflingDataset:
             bulk_transfer_deadline_s=(
                 self._runtime_policy["bulk_transfer_deadline_s"]),
             stall_action=self._runtime_policy["stall_action"])
+        # Close the delivery-latency loop at the device boundary: the
+        # probe observes delivered->device and birth->device per source
+        # table and refreshes this rank's freshness gauge (the
+        # freshness_stall detector's series).
+        self._converter.latency_probe = rt_latency.LatencyProbe(
+            queue=str(self._dataset.rank))
         self.batch_wait_stats = BatchWaitStats()
         # Persistent-prefetch state (one producer thread for ALL epochs).
         self._persistent = persistent_prefetch

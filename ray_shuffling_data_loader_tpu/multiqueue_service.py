@@ -121,6 +121,27 @@ place — same request struct, same frame struct, same recovery matrix:
   ``runtime.supervisor.launch_supervised_queue_shards`` is the
   per-shard-supervised-process topology, each shard with its own
   watermark journal (``checkpoint.shard_journal_path``).
+
+Wire format **v3.1** (delivery-latency plane, runtime/latency.py)
+appends two clock stamps to every frame header: the payload's **birth**
+(``(t_mono, t_unix, pid)`` taken where the reducer produced the table,
+read from its ``rsdl.birth`` schema metadata) and the frame's
+**queued** stamp (taken when the server built the frame). Zeroed
+stamps mean "unknown" (sentinels, failure frames, tables from a
+stamp-less producer). The server observes the ``birth_to_queued`` hop;
+the consumer observes ``queued_to_delivered`` and the end-to-end
+``birth_to_delivered`` into the ``rsdl_delivery_latency_seconds``
+sketch, labeled by trainer rank. Latency honesty across failure:
+
+- replay-buffer frames keep the stamps they were built with, so a
+  reconnect/NACK replay is delivered with its ORIGINAL birth — a
+  replay surfaces as the latency spike it really is;
+- a frame's birth is also journaled (``WatermarkJournal.record_birth``)
+  when the frame is first built, so a ``kill -9``'d server's restarted
+  incarnation re-attaches the original births to the frames it
+  regenerates — crash recovery cannot launder delivery latency into
+  recompute-fresh stamps. Exactly-once semantics (seqs, CRCs, acks)
+  are untouched by all of this: stamps are header-only evidence.
 """
 
 from __future__ import annotations
@@ -147,6 +168,7 @@ from ray_shuffling_data_loader_tpu import procpool as pp
 from ray_shuffling_data_loader_tpu.dataset import ShuffleFailure
 from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+from ray_shuffling_data_loader_tpu.runtime import latency as rt_lat
 from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
 from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
 from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
@@ -157,7 +179,23 @@ logger = setup_custom_logger(__name__)
 
 _REQUEST = struct.Struct("<BBIII")
 _BATCH_HEADER = struct.Struct("<I")
-_FRAME = struct.Struct("<BIIIQQI")
+#: v3.1 frame header: (kind|codec<<4, epoch, seq, crc, row_offset,
+#: length, task) + the delivery-latency stamps — birth (t_mono, t_unix,
+#: pid) then queued (t_mono, t_unix, pid); all-zero stamp = unknown.
+_FRAME = struct.Struct("<BIIIQQIddIddI")
+
+
+def _pack_stamp(stamp) -> tuple:
+    """A latency Stamp (or None) as the 3 header fields."""
+    if stamp is None:
+        return (0.0, 0.0, 0)
+    return (stamp.t_mono, stamp.t_unix, stamp.pid)
+
+
+def _unpack_stamp(t_mono: float, t_unix: float, pid: int):
+    if not t_mono and not t_unix:
+        return None
+    return rt_lat.Stamp(pid, t_mono, t_unix)
 
 #: Frame ``task`` value for payloads with no lineage metadata
 #: (sentinels, failure frames, tables from a non-reduce producer).
@@ -340,11 +378,12 @@ class _Frame:
 
     __slots__ = ("seq", "kind", "epoch", "wire", "crc", "row_offset",
                  "nrows", "task", "codec", "payload_bytes", "data_crc",
-                 "handle_path", "ledger_id")
+                 "handle_path", "ledger_id", "birth", "queued")
 
     def __init__(self, seq, kind, epoch, wire, crc, row_offset, nrows,
                  task=TASK_NONE, codec=CODEC_NONE, payload_bytes=None,
-                 data_crc=None, handle_path=None, ledger_id=None):
+                 data_crc=None, handle_path=None, ledger_id=None,
+                 birth=None, queued=None):
         self.seq = seq
         self.kind = kind
         self.epoch = epoch
@@ -359,6 +398,11 @@ class _Frame:
         self.data_crc = data_crc if data_crc is not None else crc
         self.handle_path = handle_path
         self.ledger_id = ledger_id
+        # Delivery-latency stamps (runtime/latency.py). A frame in the
+        # replay buffer keeps these, so replays carry the ORIGINAL
+        # birth/queued times — late delivery stays visible as such.
+        self.birth = birth
+        self.queued = queued
 
     @property
     def wire_len(self) -> int:
@@ -382,10 +426,10 @@ class _QueueState:
 
     __slots__ = ("next_seq", "sent_seq", "acked_seq", "acked_rows",
                  "rows_total", "replay", "replay_bytes", "done", "lock",
-                 "no_handles")
+                 "no_handles", "births")
 
     def __init__(self, next_seq: int = 0, rows: int = 0,
-                 done: bool = False):
+                 done: bool = False, births=None):
         self.next_seq = next_seq       # seq the next popped item gets
         self.sent_seq = next_seq - 1   # last seq sent on the live conn
         self.acked_seq = next_seq - 1  # last seq the consumer acked
@@ -396,6 +440,10 @@ class _QueueState:
         self.done = done               # sentinel acked: queue complete
         self.lock = threading.Lock()
         self.no_handles = False        # NACK_NO_HANDLE: stream-only
+        #: seq -> original birth Stamp restored from the journal: a
+        #: restarted server re-attaches these to the frames it
+        #: regenerates, so crash replays keep their true birth.
+        self.births: Dict[int, rt_lat.Stamp] = births or {}
 
 
 class _Lease:
@@ -500,13 +548,19 @@ class QueueServer:
             "rsdl_queue_shard_depth",
             "items resident across this shard's served queues",
             shard=shard)
+        self._anchors = rt_lat.ClockAnchors()
         self._states: Dict[int, _QueueState] = {}
         self._states_lock = threading.Lock()
         if initial_state:
             for q, entry in initial_state.items():
+                births = {
+                    seq: rt_lat.Stamp(int(pid), float(tm), float(tu))
+                    for seq, (pid, tm, tu) in
+                    getattr(entry, "births", {}).items()}
                 self._states[q] = _QueueState(next_seq=entry.seq + 1,
                                               rows=entry.rows,
-                                              done=entry.done)
+                                              done=entry.done,
+                                              births=births)
         self._leases: Dict[int, _Lease] = {}
         self._lease_lock = threading.Lock()
         self._lease_thread: Optional[threading.Thread] = None
@@ -623,17 +677,40 @@ class QueueServer:
 
     def _make_frame(self, queue_idx: int, seq: int, kind: int, data,
                     nrows: int, task: int, row_offset: int,
-                    want_handle: bool) -> _Frame:
+                    want_handle: bool,
+                    restored_birth=None) -> _Frame:
         """Build one frame, serializing the table exactly once. Handle
         delivery publishes the serialized buffer as a shm segment and
         puts only the ~100-byte handle blob on the wire; streamed
         delivery keeps the pa.Buffer AS the wire payload (the same
         object rides the socket and the replay buffer — satellite fix:
-        no fresh ``bytes`` copy), optionally compressed."""
+        no fresh ``bytes`` copy), optionally compressed.
+
+        Latency plane: ``restored_birth`` (the journal's stamp for this
+        seq, when this server is a restarted incarnation regenerating
+        it) wins over the table's own ``rsdl.birth`` metadata — the
+        regenerated table carries a recompute-fresh stamp, and using it
+        would launder the crash out of the latency record. A NEWLY
+        assigned seq's birth is journaled here (flush, no fsync), and
+        the ``birth_to_queued`` hop is observed server-side."""
         epoch = self._epoch_of(queue_idx)
+        queued = rt_lat.now_stamp()
         if kind != KIND_TABLE:
             return _Frame(seq, kind, epoch, data, _crc(data), row_offset,
-                          nrows, task)
+                          nrows, task, queued=queued)
+        birth = restored_birth
+        if birth is None:
+            meta = data.schema.metadata
+            birth = rt_lat.parse_stamp(
+                meta.get(rt_lat.BIRTH_META_KEY) if meta else None)
+            if birth is not None and self._journal is not None:
+                self._journal.record_birth(queue_idx, seq, *birth)
+        if birth is not None:
+            rt_lat.observe_hop(
+                rt_lat.HOP_BIRTH_TO_QUEUED,
+                str(plan_ir.queue_rank(queue_idx, self._num_trainers)),
+                self._anchors.latency_s(birth, now_mono=queued.t_mono,
+                                        now_unix=queued.t_unix))
         buf = _serialize(data)
         logical = buf.size
         data_crc = _crc(buf)
@@ -650,7 +727,8 @@ class QueueServer:
             return _Frame(seq, KIND_TABLE_HANDLE, epoch, blob, _crc(blob),
                           row_offset, nrows, task,
                           payload_bytes=logical, data_crc=data_crc,
-                          handle_path=path, ledger_id=ledger_id)
+                          handle_path=path, ledger_id=ledger_id,
+                          birth=birth, queued=queued)
         self._handle_misses.inc()
         wire: object = buf
         codec = CODEC_NONE
@@ -662,7 +740,7 @@ class QueueServer:
                 self._compression_saved.inc(logical - len(compressed))
         return _Frame(seq, KIND_TABLE, epoch, wire, data_crc, row_offset,
                       nrows, task, codec=codec, payload_bytes=logical,
-                      data_crc=data_crc)
+                      data_crc=data_crc, birth=birth, queued=queued)
 
     def _downgrade_frame(self, frame: _Frame) -> _Frame:
         """Replay a handle frame as a byte stream (NACK_NO_HANDLE): mmap
@@ -676,7 +754,8 @@ class QueueServer:
                       frame.task, payload_bytes=frame.payload_bytes,
                       data_crc=frame.data_crc,
                       handle_path=frame.handle_path,
-                      ledger_id=frame.ledger_id)
+                      ledger_id=frame.ledger_id,
+                      birth=frame.birth, queued=frame.queued)
 
     def _note_shard_depth(self) -> None:
         if rt_telemetry.stamp():
@@ -773,10 +852,13 @@ class QueueServer:
                     # consumed (its ack outran the journal's last fsync):
                     # drop it, but keep the row accounting advancing.
                     state.acked_rows = row_offset + nrows
+                    state.births.pop(seq, None)
                     continue
                 frame = self._make_frame(queue_idx, seq, kind, data,
                                          nrows, task, row_offset,
-                                         want_handle)
+                                         want_handle,
+                                         restored_birth=state.births.pop(
+                                             seq, None))
                 state.replay.append(frame)
                 state.replay_bytes += frame.size
                 frames.append(frame)
@@ -793,7 +875,9 @@ class QueueServer:
             kind_byte = frame.kind | (frame.codec << 4)
             header = _FRAME.pack(kind_byte, frame.epoch, frame.seq,
                                  frame.crc, frame.row_offset, size,
-                                 frame.task)
+                                 frame.task,
+                                 *_pack_stamp(frame.birth),
+                                 *_pack_stamp(frame.queued))
             try:
                 rt_faults.inject("conn_reset_midframe", epoch=frame.epoch,
                                  task=queue_idx)
@@ -838,7 +922,8 @@ class QueueServer:
         payload)."""
         return (_BATCH_HEADER.pack(1)
                 + _FRAME.pack(KIND_FAILURE, 0, ACK_NONE, _crc(text), 0,
-                              len(text), TASK_NONE) + text)
+                              len(text), TASK_NONE, 0.0, 0.0, 0,
+                              0.0, 0.0, 0) + text)
 
     def _serve_conn(self, conn: socket.socket) -> None:
         consumer_id: Optional[int] = None
@@ -1218,6 +1303,11 @@ class RemoteQueue:
       between GETs (long train steps must not read as a dead trainer).
     """
 
+    #: Consumer-side delivery-latency hops are observed HERE (the wire
+    #: client sees the stamps first); datasets layered on top read this
+    #: marker and skip their own birth_to_delivered observation.
+    observes_delivery = True
+
     def __init__(self, address: Tuple[str, int],
                  retries: int = mq.CONNECT_RETRIES,
                  initial_backoff_s: float = mq.CONNECT_INITIAL_BACKOFF_S,
@@ -1225,12 +1315,20 @@ class RemoteQueue:
                  prefetch: bool = True,
                  ack_mode: str = "delivered",
                  consumer_id: Optional[int] = None,
-                 delivery: Optional[str] = None):
+                 delivery: Optional[str] = None,
+                 num_trainers: int = 1):
         if ack_mode not in ("delivered", "manual"):
             raise ValueError(
                 f"ack_mode must be 'delivered' or 'manual', got {ack_mode!r}")
         self._address = address
         self._ack_mode = ack_mode
+        # Latency-plane labeling: the queue label is the TRAINER RANK
+        # (bounded cardinality), derived from the queue index by the
+        # plan's route contract. Single-trainer consumers (the default)
+        # resolve every queue to rank 0; sharded consumers get the real
+        # width from their shard map.
+        self._num_trainers = max(1, int(num_trainers))
+        self._lat_anchors = rt_lat.ClockAnchors()
         # Shm-handle capability (v3): "auto" offers handles when the
         # server address is loopback (same host by construction);
         # "handle" forces the offer (shared shm mounts); "stream" never
@@ -1399,11 +1497,14 @@ class RemoteQueue:
                     handle_fail_seq = None
                     for _ in range(count):
                         (kind_byte, epoch, seq, crc, row_offset, length,
-                         src_task) = _FRAME.unpack(
+                         src_task, b_mono, b_unix, b_pid, q_mono, q_unix,
+                         q_pid) = _FRAME.unpack(
                              _recv_exact(self._sock, _FRAME.size))
                         kind = kind_byte & _KIND_MASK
                         codec = kind_byte >> 4
                         epoch_hint = epoch
+                        birth = _unpack_stamp(b_mono, b_unix, b_pid)
+                        queued = _unpack_stamp(q_mono, q_unix, q_pid)
                         payload = (_recv_payload(self._sock, length)
                                    if length else b"")
                         if corrupt_seq is not None \
@@ -1469,7 +1570,8 @@ class RemoteQueue:
                             # (epoch, task).
                             rt_telemetry.record("frame_recv", epoch=epoch,
                                                 task=src_task, seq=seq)
-                        frames.append((kind, seq, row_offset, raw))
+                        frames.append((kind, seq, row_offset, raw,
+                                       birth, queued))
                     if corrupt_seq is not None:
                         self._sock.sendall(_REQUEST.pack(
                             OP_NACK, 0, queue_index, corrupt_seq,
@@ -1514,13 +1616,13 @@ class RemoteQueue:
                 _round_trip, describe=f"fetch queue {queue_index}",
                 on_retry=_redial)
         items: List[Tuple] = []
-        for kind, seq, row_offset, payload in frames:
+        for kind, seq, row_offset, payload, birth, queued in frames:
             if kind == KIND_SENTINEL:
-                items.append((seq, None, None))
+                items.append((seq, None, None, None, None))
                 break  # epoch over; nothing valid can follow
             if kind == KIND_FAILURE:
                 items.append((seq, None, ShuffleFailure(
-                    RuntimeError(bytes(payload).decode()))))
+                    RuntimeError(bytes(payload).decode())), None, None))
                 break
             # ``payload`` is a pa.Buffer (mmap'd segment), a memoryview
             # of the recv buffer, or decompressed bytes — all read
@@ -1529,7 +1631,8 @@ class RemoteQueue:
             source = (payload if isinstance(payload, pa.Buffer)
                       else pa.py_buffer(payload))
             with pa.ipc.open_stream(pa.BufferReader(source)) as reader:
-                items.append((seq, row_offset, reader.read_all()))
+                items.append((seq, row_offset, reader.read_all(),
+                              birth, queued))
         return items, resumed
 
     def _epoch_over(self, entry) -> bool:
@@ -1545,10 +1648,24 @@ class RemoteQueue:
             # replay (same seqs), so drop them rather than double-buffer.
             buf.clear()
         delivered = self._delivered[queue_index]
+        rank = str(plan_ir.queue_rank(queue_index, self._num_trainers))
         fresh = []
-        for seq, row_offset, item in items:
+        for seq, row_offset, item, birth, queued in items:
             if seq <= delivered or (buf and seq <= buf[-1][0]):
                 continue  # replayed frame we already have: exactly-once
+            # Delivery-latency hops, observed only for frames actually
+            # entering the stream (a dup dropped by seq above was
+            # already delivered once — observing it again would count
+            # one payload twice). Replayed frames carry their ORIGINAL
+            # stamps, so a replay records its true, crash/reset-spanning
+            # latency here.
+            rt_lat.observe_hop(rt_lat.HOP_QUEUED_TO_DELIVERED, rank,
+                               self._lat_anchors.latency_s(queued))
+            if birth is not None:
+                age = self._lat_anchors.latency_s(birth)
+                rt_lat.observe_hop(rt_lat.HOP_BIRTH_TO_DELIVERED, rank,
+                                   age)
+                rt_lat.set_freshness(rank, age)
             if item is None and row_offset is None:
                 fresh.append((seq, None, None))
             else:
@@ -1651,6 +1768,9 @@ class ShardedRemoteQueue:
     shard never stalls a stream served by its siblings.
     """
 
+    #: See RemoteQueue.observes_delivery (every shard client observes).
+    observes_delivery = True
+
     def __init__(self, shard_map: Union[plan_ir.ShardMap, dict, str],
                  **remote_kwargs):
         if isinstance(shard_map, str):
@@ -1659,6 +1779,9 @@ class ShardedRemoteQueue:
             shard_map = plan_ir.ShardMap.from_dict(shard_map)
         shard_map.validate()
         self._shard_map = shard_map
+        # The shard map knows the trainer width — hand it to each shard
+        # client so latency-plane queue labels resolve to real ranks.
+        remote_kwargs.setdefault("num_trainers", shard_map.num_trainers)
         self._remote_kwargs = remote_kwargs
         self._clients: Dict[int, RemoteQueue] = {}
         self._clients_lock = threading.Lock()

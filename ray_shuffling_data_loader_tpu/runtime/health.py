@@ -30,6 +30,16 @@ Detectors (thresholds under their policy keys; ``RSDL_SLO_<KEY>`` env):
                           ``slo_lease_churn_per_min``
 ``straggler_drift``       the critical-path straggler's seconds exceeded
                           ``slo_straggler_drift_x`` × the rolling median
+``delivery_latency_breach``  any queue's windowed p99 of the end-to-end
+                          ``birth_to_delivered`` hop (the
+                          ``rsdl_delivery_latency_seconds`` sketch,
+                          runtime/latency.py) exceeded
+                          ``slo_delivery_p99_s``
+``freshness_stall``       any queue's EFFECTIVE freshness — the
+                          ``rsdl_delivery_freshness_seconds`` gauge plus
+                          how long it has sat unchanged (a pipeline that
+                          stops delivering freezes its gauge; the age
+                          keeps growing) — exceeded ``slo_freshness_s``
 ========================  =================================================
 
 On fire (or on ``SIGUSR2`` — :func:`install_incident_signal`, the
@@ -304,10 +314,113 @@ class StragglerDriftDetector(Detector):
         return None
 
 
+_DELIVERY_CENTROID_SERIES = "rsdl_delivery_latency_seconds_centroid"
+_FRESHNESS_SERIES = "rsdl_delivery_freshness_seconds"
+
+
+class DeliveryLatencyDetector(Detector):
+    """Windowed per-queue p99 of the end-to-end birth->delivered hop.
+
+    The sketch's centroid counts are cumulative per label set, so the
+    window's distribution is the element-wise DELTA between the newest
+    snapshot and the one ``slo_droop_window_ticks`` back — handed to
+    the same quantile math every other sketch reader uses
+    (``metrics.sketch_quantiles``). Breaches on the WORST queue: the
+    SLO is per-queue, and averaging ranks together would let one
+    starving trainer hide behind its siblings."""
+
+    name = "delivery_latency_breach"
+
+    def __init__(self, component: str = "health", **overrides: Any):
+        super().__init__(component, **overrides)
+        self.p99_s = self._resolve("slo_delivery_p99_s")
+        self.window_ticks = self._resolve("slo_droop_window_ticks")
+
+    def evaluate(self, ring: rt_history.HistoryRing) -> Optional[Breach]:
+        snaps = ring.snapshots()
+        if len(snaps) < 2:
+            return None
+        window = max(1, int(self.window_ticks))
+        now = snaps[-1]["samples"].get(_DELIVERY_CENTROID_SERIES)
+        if not now:
+            return None
+        base = snaps[max(0, len(snaps) - 1 - window)]["samples"].get(
+            _DELIVERY_CENTROID_SERIES, {})
+        delta = {}
+        for labels, value in now.items():
+            d = value - base.get(labels, 0.0)
+            if d > 0:
+                delta[labels] = d
+        if not delta:
+            return None
+        stats = rt_metrics.sketch_quantiles(
+            {_DELIVERY_CENTROID_SERIES: delta},
+            "rsdl_delivery_latency_seconds", qs=(0.99,),
+            hop="birth_to_delivered")
+        worst = None
+        for labels, entry in stats.items():
+            queue = dict(labels).get("queue", "?")
+            if worst is None or entry["p99"] > worst[0]:
+                worst = (entry["p99"], queue, int(entry["count"]))
+        if worst is not None and worst[0] > self.p99_s:
+            p99, queue, count = worst
+            return self._breach(
+                p99, self.p99_s,
+                f"queue {queue} delivery p99 {p99:.2f}s over the last "
+                f"{count} frame(s)")
+        return None
+
+
+class FreshnessStallDetector(Detector):
+    """Effective payload freshness at the consumer's final hop.
+
+    The freshness gauge is set to the newest payload's birth age at
+    each delivery — so when deliveries STOP, the gauge freezes while
+    the data keeps aging. The detector therefore judges
+    ``gauge value + seconds the gauge has sat unchanged`` (scanned back
+    through the retained snapshots), catching both stale-data delivery
+    and no-data stalls with one threshold."""
+
+    name = "freshness_stall"
+
+    def __init__(self, component: str = "health", **overrides: Any):
+        super().__init__(component, **overrides)
+        self.freshness_s = self._resolve("slo_freshness_s")
+
+    def evaluate(self, ring: rt_history.HistoryRing) -> Optional[Breach]:
+        snaps = ring.snapshots()
+        if not snaps:
+            return None
+        latest = snaps[-1]
+        series = latest["samples"].get(_FRESHNESS_SERIES)
+        if not series:
+            return None
+        worst = None
+        for labels, value in series.items():
+            t_change = latest["t"]
+            for snap in reversed(snaps[:-1]):
+                prev = snap["samples"].get(_FRESHNESS_SERIES,
+                                           {}).get(labels)
+                if prev is None or prev != value:
+                    break
+                t_change = snap["t"]
+            effective = value + max(0.0, latest["t"] - t_change)
+            if worst is None or effective > worst[0]:
+                worst = (effective, value, dict(labels).get("queue", "?"))
+        if worst is not None and worst[0] > self.freshness_s:
+            effective, raw, queue = worst
+            return self._breach(
+                effective, self.freshness_s,
+                f"queue {queue} freshness {effective:.1f}s "
+                f"(last delivered age {raw:.1f}s)")
+        return None
+
+
 _DETECTOR_TYPES: Dict[str, type] = {
     cls.name: cls for cls in (
         ThroughputDroopDetector, StallBreachDetector, LedgerCreepDetector,
-        QueueSaturationDetector, LeaseChurnDetector, StragglerDriftDetector)
+        QueueSaturationDetector, LeaseChurnDetector, StragglerDriftDetector,
+        DeliveryLatencyDetector, FreshnessStallDetector)
 }
 
 
@@ -670,9 +783,23 @@ def capture_incident(reason: str = "on-demand",
     # 1. Flush this process's shard so the merged exposition is current,
     #    then freeze the cluster-wide view.
     rt_metrics.write_shard()
+    federated_text = rt_metrics.render_federated()
     with open(os.path.join(capsule, "metrics.prom"), "w",
               encoding="utf-8") as f:
-        f.write(rt_metrics.render_federated())
+        f.write(federated_text)
+    # Delivery-latency slice of the frozen exposition: the capsule's
+    # manifest answers "how late was delivery when this fired" without
+    # re-deriving quantiles from the .prom file.
+    latency_summary: Dict[str, Any] = {}
+    try:
+        samples = rt_metrics.parse_exposition(federated_text)
+        for labels, stats in sorted(rt_metrics.sketch_quantiles(
+                samples, "rsdl_delivery_latency_seconds").items()):
+            key = ",".join(f"{k}={v}" for k, v in labels)
+            latency_summary[key] = {
+                name: round(value, 6) for name, value in stats.items()}
+    except (ValueError, KeyError):
+        logger.exception("incident latency summary failed")
 
     # 2. History slice (armed ring, explicit ring, or none).
     ring = ring or rt_history.get_history()
@@ -769,6 +896,7 @@ def capture_incident(reason: str = "on-demand",
         "pids_signaled": signaled,
         "traces": trace_files,
         "profile": profile_summary,
+        "latency": latency_summary,
         "files": sorted(os.listdir(capsule)),
     }
     with open(os.path.join(capsule, "capsule.json"), "w",

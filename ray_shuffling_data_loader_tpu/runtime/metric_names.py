@@ -70,6 +70,12 @@ METRIC_NAMES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "rsdl_queue_compression_saved_bytes_total": ("counter", ("shard",)),
     "rsdl_queue_shard_depth": ("gauge", ("shard",)),
     "rsdl_queue_serve_shards": ("gauge", ()),
+    # -- delivery-latency plane (runtime/latency.py; queue label is the
+    #    TRAINER RANK — bounded — never a raw queue id/seq/pid; the
+    #    metric-label-cardinality lint rule enforces the label sets
+    #    declared here) --
+    "rsdl_delivery_latency_seconds": ("sketch", ("hop", "queue")),
+    "rsdl_delivery_freshness_seconds": ("gauge", ("queue",)),
     # -- spill tier (spill.py) --
     "rsdl_spills_total": ("counter", ()),
     "rsdl_spilled_bytes_total": ("counter", ()),

@@ -37,10 +37,11 @@ import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 __all__ = [
-    "Counter", "Gauge", "Histogram", "Registry", "REGISTRY",
-    "counter", "gauge", "histogram", "get", "render", "parse_exposition",
-    "parse_exposition_typed", "write_file", "start_http_server",
-    "start_exporter", "DEFAULT_LATENCY_BUCKETS",
+    "Counter", "Gauge", "Histogram", "Sketch", "Registry", "REGISTRY",
+    "counter", "gauge", "histogram", "sketch", "get", "render",
+    "parse_exposition", "parse_exposition_typed", "write_file",
+    "start_http_server", "start_exporter", "DEFAULT_LATENCY_BUCKETS",
+    "DEFAULT_LATENCY_CENTROIDS", "sketch_quantiles",
     "telemetry_dir", "write_shard", "read_shards", "merge_series",
     "federated_series", "render_federated", "maybe_start_shard_writer",
 ]
@@ -50,6 +51,16 @@ __all__ = [
 DEFAULT_LATENCY_BUCKETS: Tuple[float, ...] = (
     0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
     0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0)
+
+#: Fixed latency-sketch centroids in SECONDS: 12 per decade,
+#: geometrically spaced over 100us..100s (73 values, ratio 10^(1/12)
+#: ~= 1.21 — quantile estimates land within ~±10% of truth, which is
+#: the error a p99 SLO can live with). FIXED on purpose: every process
+#: assigns an observation to the same centroid, so per-pid counts sum
+#: EXACTLY under the shard federation (`merge_series`) — the property
+#: mergeable-quantile structures (t-digest et al.) only approximate.
+DEFAULT_LATENCY_CENTROIDS: Tuple[float, ...] = tuple(
+    round(10.0 ** (exp / 12.0), 9) for exp in range(-48, 25))
 
 
 class Counter:
@@ -194,6 +205,108 @@ class Histogram:
         return self.bounds[-1]
 
 
+class Sketch:
+    """Mergeable fixed-centroid latency sketch (the delivery-latency
+    plane's quantile primitive, runtime/latency.py).
+
+    Observations snap to the nearest of a FIXED geometric centroid set
+    (boundaries at geometric midpoints), so the sketch is a sparse
+    ``{centroid: count}`` map. Quantiles read the cumulative walk over
+    centroids; merging is plain per-centroid addition — **exact** under
+    `merge_series`-style summation across process shards, unlike
+    adaptive-centroid sketches whose merge is lossy. Exposition renders
+    one ``name_centroid{c="<seconds>"} count`` line per NON-ZERO
+    centroid plus ``_sum``/``_count``, so the text format stays sparse
+    and round-trips through :func:`parse_exposition`.
+    """
+
+    __slots__ = ("centroids", "_bounds", "_counts", "_sum", "_count",
+                 "_lock")
+    kind = "sketch"
+
+    def __init__(self,
+                 centroids: Iterable[float] = DEFAULT_LATENCY_CENTROIDS):
+        self.centroids: Tuple[float, ...] = tuple(sorted(centroids))
+        if not self.centroids:
+            raise ValueError("sketch needs at least one centroid")
+        # Assignment boundaries: geometric midpoints between adjacent
+        # centroids (natural for a log-spaced set).
+        self._bounds = [
+            (self.centroids[i] * self.centroids[i + 1]) ** 0.5
+            for i in range(len(self.centroids) - 1)]
+        self._counts = [0] * len(self.centroids)
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, value: float) -> None:
+        value = max(0.0, float(value))
+        index = bisect.bisect_right(self._bounds, value)
+        with self._lock:
+            self._counts[index] += 1
+            self._sum += value
+            self._count += 1
+
+    def merge(self, other: "Sketch") -> None:
+        """Add ``other``'s centroid counts into this sketch (exact)."""
+        if other.centroids != self.centroids:
+            raise ValueError("cannot merge sketches with different "
+                             "centroid sets")
+        with other._lock:
+            counts = list(other._counts)
+            osum, ocount = other._sum, other._count
+        with self._lock:
+            for i, c in enumerate(counts):
+                self._counts[i] += c
+            self._sum += osum
+            self._count += ocount
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def centroid_counts(self) -> Dict[float, int]:
+        """Sparse ``{centroid_seconds: count}`` of non-zero centroids."""
+        with self._lock:
+            return {c: n for c, n in zip(self.centroids, self._counts)
+                    if n}
+
+    def percentile(self, q: float) -> float:
+        """q-quantile (q in [0, 1]) over the centroid mass; 0.0 when
+        empty. By construction within one centroid-spacing ratio of the
+        true quantile."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"q must be in [0, 1], got {q}")
+        with self._lock:
+            counts = list(self._counts)
+            total = self._count
+        return _centroid_quantile(
+            {c: n for c, n in zip(self.centroids, counts) if n}, total, q)
+
+
+def _centroid_quantile(counts: Dict[float, int], total: int,
+                       q: float) -> float:
+    """Quantile over a sparse {centroid: count} mass (shared by
+    :meth:`Sketch.percentile` and :func:`sketch_quantiles`)."""
+    if total <= 0:
+        return 0.0
+    rank = q * total
+    seen = 0.0
+    last = 0.0
+    for centroid in sorted(counts):
+        last = centroid
+        seen += counts[centroid]
+        if seen >= rank:
+            return centroid
+    return last
+
+
 Labels = Tuple[Tuple[str, str], ...]
 
 
@@ -224,6 +337,11 @@ class _Family:
                     metric = Counter()
                 elif self.kind == "gauge":
                     metric = Gauge()
+                elif self.kind == "sketch":
+                    # The centroid set is deliberately NOT configurable:
+                    # fixed centroids are what make cross-pid merges
+                    # exact (every process bins identically).
+                    metric = Sketch()
                 else:
                     metric = Histogram(self.buckets
                                        or DEFAULT_LATENCY_BUCKETS)
@@ -271,6 +389,10 @@ class Registry:
         return self._family(name, "histogram", help_text,
                             buckets=buckets).child(labels)
 
+    def sketch(self, name: str, help_text: str = "", /,
+               **labels: str) -> Sketch:
+        return self._family(name, "sketch", help_text).child(labels)
+
     def get(self, name: str, labels: Optional[Dict[str, str]] = None):
         """Look up a registered metric: the family when ``labels`` is
         None and the family is labeled, else the child. Returns None
@@ -304,6 +426,18 @@ class Registry:
                 label_txt = _format_labels(labels)
                 if family.kind in ("counter", "gauge"):
                     out.append(f"{name}{label_txt} {_fmt(metric.value)}")
+                    continue
+                if family.kind == "sketch":
+                    # Sparse: one line per non-zero centroid. Counts are
+                    # NON-cumulative so federation summing is exact.
+                    for centroid, count in sorted(
+                            metric.centroid_counts().items()):
+                        ct = _label_key(dict(labels)
+                                        | {"c": _fmt(centroid)})
+                        out.append(f"{name}_centroid{_format_labels(ct)} "
+                                   f"{count}")
+                    out.append(f"{name}_sum{label_txt} {_fmt(metric.sum)}")
+                    out.append(f"{name}_count{label_txt} {metric.count}")
                     continue
                 cumulative = 0
                 counts = metric.bucket_counts()
@@ -354,6 +488,10 @@ def gauge(name: str, help_text: str = "", /, **labels: str) -> Gauge:
 def histogram(name: str, help_text: str = "", /, buckets=None,
               **labels: str) -> Histogram:
     return REGISTRY.histogram(name, help_text, buckets=buckets, **labels)
+
+
+def sketch(name: str, help_text: str = "", /, **labels: str) -> Sketch:
+    return REGISTRY.sketch(name, help_text, **labels)
 
 
 def get(name: str, labels: Optional[Dict[str, str]] = None):
@@ -442,6 +580,45 @@ def _parse_labels(text: str) -> Labels:
         labels.append((key, "".join(value)))
         i = j + 1
     return tuple(sorted(labels))
+
+
+def sketch_quantiles(samples: Dict[str, "Dict[Labels, float]"],
+                     name: str,
+                     qs: Tuple[float, ...] = (0.5, 0.95, 0.99),
+                     **label_filter: str
+                     ) -> "Dict[Labels, Dict[str, float]]":
+    """Quantiles of a sketch family from PARSED exposition samples
+    (one process's, or the federation-merged view — the centroid counts
+    sum exactly either way).
+
+    Groups ``<name>_centroid`` samples by their labels minus the
+    structural ``c`` label, optionally restricted by ``label_filter``
+    equality; returns ``{group_labels: {"p50": s, ..., "count": n}}``
+    (quantile keys are ``p<100q>`` in seconds). Tools (rsdl_top, the
+    run report), the health detectors and the bench latency leg all
+    read the plane through this one function.
+    """
+    grouped: Dict[Labels, Dict[float, int]] = {}
+    for labels, value in samples.get(f"{name}_centroid", {}).items():
+        d = dict(labels)
+        centroid_txt = d.pop("c", None)
+        if centroid_txt is None:
+            continue
+        if any(d.get(k) != str(v) for k, v in label_filter.items()):
+            continue
+        key = tuple(sorted(d.items()))
+        counts = grouped.setdefault(key, {})
+        centroid = float(centroid_txt)
+        counts[centroid] = counts.get(centroid, 0.0) + value
+    out: Dict[Labels, Dict[str, float]] = {}
+    for key, counts in grouped.items():
+        total = int(sum(counts.values()))
+        stats = {"count": float(total)}
+        for q in qs:
+            stats[f"p{int(round(q * 100))}"] = _centroid_quantile(
+                counts, total, q)
+        out[key] = stats
+    return out
 
 
 # ---------------------------------------------------------------------------
@@ -572,7 +749,7 @@ def render_merged(samples: Dict[str, Dict[Labels, float]],
     typed_done = set()
     for name in sorted(samples):
         base = name
-        for suffix in ("_bucket", "_sum", "_count"):
+        for suffix in ("_bucket", "_centroid", "_sum", "_count"):
             if name.endswith(suffix) and name[:-len(suffix)] in types:
                 base = name[:-len(suffix)]
                 break

@@ -134,6 +134,13 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     "slo_queue_depth": (100000.0, float),  # per-queue item saturation
     "slo_lease_churn_per_min": (3.0, float),
     "slo_straggler_drift_x": (4.0, float),  # straggler vs rolling median
+    # Delivery-latency plane (runtime/latency.py): windowed p99 of the
+    # end-to-end birth->delivered hop above which delivery_latency_breach
+    # fires, and the effective freshness age (newest payload's birth age
+    # at the consumer's final hop, PLUS how long that gauge has been
+    # frozen) above which freshness_stall fires.
+    "slo_delivery_p99_s": (30.0, float),
+    "slo_freshness_s": (120.0, float),
     # Incident capsules (runtime/health.py): where capsule directories
     # land ("" = trace_dir, else telemetry_dump_dir, else temp dir), how
     # long the profiler burst samples, and how long capture waits for

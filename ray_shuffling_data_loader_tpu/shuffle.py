@@ -1030,9 +1030,17 @@ def account_and_maybe_spill(shuffled: pa.Table, spill_manager,
     reduce span that built it; the queue service copies the task id
     into its v2 frame headers from here."""
     if epoch is not None and task is not None:
+        from ray_shuffling_data_loader_tpu.runtime import latency as rt_lat
         meta = dict(shuffled.schema.metadata or {})
         meta[b"rsdl.trace"] = f"{seed if seed is not None else 0}:" \
                               f"{epoch}:{task}".encode()
+        # Birth stamp (runtime/latency.py): the delivery-latency plane's
+        # t=0 for this payload. Both clocks + the producing pid ride
+        # along so any downstream process (queue shard, trainer, device
+        # loop) computes a skew-proof age; like rsdl.trace, the stamp
+        # survives slicing, IPC, spill and the queue wire.
+        meta[rt_lat.BIRTH_META_KEY] = rt_lat.encode_stamp(
+            rt_lat.now_stamp())
         shuffled = shuffled.replace_schema_metadata(meta)
     from ray_shuffling_data_loader_tpu import native
     native.account_table(shuffled)

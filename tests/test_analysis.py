@@ -420,6 +420,34 @@ UNREGISTERED_METRIC_OK = """
         rt_metrics.get(name)
 """
 
+METRIC_LABEL_CARD_BAD = """
+    from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+
+    def serve(task_id, seq):
+        # task/seq are unbounded identities — one child series per value
+        rt_metrics.counter("rsdl_queue_frames_replayed_total", "r",
+                           task=str(task_id)).inc()
+        rt_metrics.sketch("rsdl_delivery_latency_seconds", "lat",
+                          hop="birth_to_delivered",
+                          seq=str(seq)).observe(0.1)
+"""
+
+METRIC_LABEL_CARD_OK = """
+    from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+
+    def serve(shard_index, rank):
+        # catalog-declared labels pass, whatever expression builds the
+        # value; histogram config kwargs are not labels; uncataloged
+        # names are unregistered-metric's finding, not this rule's
+        rt_metrics.counter("rsdl_queue_handle_hits_total", "h",
+                           shard=str(shard_index)).inc()
+        rt_metrics.sketch("rsdl_delivery_latency_seconds", "lat",
+                          hop="birth_to_delivered",
+                          queue=str(rank)).observe(0.1)
+        rt_metrics.histogram("rsdl_batch_wait_seconds", "w",
+                             buckets=(0.1, 1.0)).observe(0.2)
+"""
+
 LINEAGE_PLAN_ROUTE_BAD = """
     def route(epoch, rank, num_trainers):
         return epoch * num_trainers + rank
@@ -506,6 +534,9 @@ CASES = [
     ("bytes-concat-in-loop", BYTES_CONCAT_REBIND_BAD, BYTES_CONCAT_OK, {}),
     ("unregistered-metric", UNREGISTERED_METRIC_BAD, UNREGISTERED_METRIC_OK,
      {"path": "ray_shuffling_data_loader_tpu/multiqueue.py"}),
+    ("metric-label-cardinality", METRIC_LABEL_CARD_BAD,
+     METRIC_LABEL_CARD_OK,
+     {"path": "ray_shuffling_data_loader_tpu/multiqueue_service.py"}),
     ("lineage-outside-plan", LINEAGE_PLAN_ROUTE_BAD, LINEAGE_PLAN_OK,
      {"path": "ray_shuffling_data_loader_tpu/dataset.py"}),
     ("lineage-outside-plan", LINEAGE_PLAN_INVERSE_BAD, LINEAGE_PLAN_OK,
@@ -545,8 +576,22 @@ def test_metric_catalog_covers_every_registered_name():
         METRIC_NAMES)
     for name, (kind, labels) in METRIC_NAMES.items():
         assert name.startswith("rsdl_"), name
-        assert kind in ("counter", "gauge", "histogram"), (name, kind)
+        assert kind in ("counter", "gauge", "histogram", "sketch"), \
+            (name, kind)
         assert isinstance(labels, tuple), name
+
+
+def test_metric_label_cardinality_scoped_to_library_code():
+    # Tests may mint throwaway labels; library code may not. The two
+    # BAD label keys (task=, seq=) are each their own finding.
+    flagged, _ = lint(METRIC_LABEL_CARD_BAD, path="tests/test_x.py")
+    assert "metric-label-cardinality" not in flagged
+    flagged, violations = lint(
+        METRIC_LABEL_CARD_BAD,
+        path="ray_shuffling_data_loader_tpu/multiqueue_service.py")
+    assert "metric-label-cardinality" in flagged
+    assert sum(1 for v in violations
+               if v.rule == "metric-label-cardinality") == 2
 
 
 def test_copy_in_hot_path_scoped_to_hot_path_modules():

@@ -1,0 +1,475 @@
+"""Delivery-latency plane (runtime/latency.py + the metrics sketch).
+
+The plane's contract, pinned end to end: birth stamps survive the
+producer -> queue -> wire -> consumer path; the fixed-centroid sketch
+merges EXACTLY across registries/shards; per-pid clock re-anchoring
+never reports a negative or wall-skew-polluted latency; journaled
+births make crash replays keep their original birth; and the two SLO
+detectors fire once per episode under the standard hysteresis.
+"""
+
+import threading
+import time
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+from ray_shuffling_data_loader_tpu import data_generation as dg
+from ray_shuffling_data_loader_tpu import multiqueue as mq
+from ray_shuffling_data_loader_tpu import multiqueue_service as svc
+from ray_shuffling_data_loader_tpu.dataset import ShufflingDataset
+from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+from ray_shuffling_data_loader_tpu.runtime import health as rt_health
+from ray_shuffling_data_loader_tpu.runtime import latency as rt_lat
+from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+from ray_shuffling_data_loader_tpu.shuffle import shuffle as run_shuffle
+
+DELIVERY = "rsdl_delivery_latency_seconds"
+CENTROID_SERIES = f"{DELIVERY}_centroid"
+
+
+# ---------------------------------------------------------------------------
+# Sketch: quantiles, exact merge, exposition round-trip
+# ---------------------------------------------------------------------------
+
+
+def test_sketch_percentile_within_centroid_ratio():
+    import random
+    rng = random.Random(7)
+    sk = rt_metrics.Sketch()
+    values = sorted(rng.uniform(0.0005, 2.0) for _ in range(2000))
+    for v in values:
+        sk.observe(v)
+    ratio = 10.0 ** (1.0 / 12.0)  # centroid spacing
+    for q in (0.5, 0.9, 0.99):
+        true = values[min(len(values) - 1, int(q * len(values)))]
+        est = sk.percentile(q)
+        assert true / ratio ** 1.5 <= est <= true * ratio ** 1.5, \
+            (q, est, true)
+
+
+def test_sketch_merge_is_exact_count_addition():
+    a, b = rt_metrics.Sketch(), rt_metrics.Sketch()
+    for v in (0.001, 0.01, 0.01):
+        a.observe(v)
+    for v in (5.0, 9.0):
+        b.observe(v)
+    a.merge(b)
+    assert a.count == 5
+    merged = a.centroid_counts()
+    direct = rt_metrics.Sketch()
+    for v in (0.001, 0.01, 0.01, 5.0, 9.0):
+        direct.observe(v)
+    assert merged == direct.centroid_counts()
+
+
+def test_sketch_exposition_round_trip_and_federated_merge():
+    """The check-latency contract: two registries' sketches rendered,
+    parsed and summed by the federation reader yield the SAME quantiles
+    as one directly-merged sketch — fixed centroids make the cross-pid
+    merge exact, not approximate."""
+    values_a = [0.002, 0.02, 0.2]
+    values_b = [1.0, 3.0]
+    regs = [rt_metrics.Registry(), rt_metrics.Registry()]
+    for reg, values in zip(regs, (values_a, values_b)):
+        child = reg.sketch(DELIVERY, "t", hop="birth_to_delivered",
+                           queue="1")
+        for v in values:
+            child.observe(v)
+    shards = [rt_metrics.parse_exposition_typed(reg.render())
+              for reg in regs]
+    merged, types = rt_metrics.merge_series(shards)
+    assert types[DELIVERY] == "sketch"
+    stats = rt_metrics.sketch_quantiles(merged, DELIVERY,
+                                        hop="birth_to_delivered")
+    (labels, entry), = stats.items()
+    assert dict(labels)["queue"] == "1"
+    direct = rt_metrics.Sketch()
+    for v in values_a + values_b:
+        direct.observe(v)
+    assert int(entry["count"]) == direct.count
+    for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+        assert entry[key] == pytest.approx(direct.percentile(q))
+    # The merged view renders back to text that round-trips (the
+    # federated exposition file/endpoint serve this form).
+    reparsed, _ = rt_metrics.parse_exposition_typed(
+        rt_metrics.render_merged(merged, types))
+    assert reparsed[CENTROID_SERIES] == merged[CENTROID_SERIES]
+
+
+# ---------------------------------------------------------------------------
+# Stamps + per-pid clock re-anchoring
+# ---------------------------------------------------------------------------
+
+
+def test_stamp_metadata_round_trip_and_corrupt_input():
+    stamp = rt_lat.now_stamp()
+    assert rt_lat.parse_stamp(rt_lat.encode_stamp(stamp)) == stamp
+    for bad in (None, b"", b"junk", b"1:2", "a:b:c", b"1:x:3"):
+        assert rt_lat.parse_stamp(bad) is None
+
+
+def test_anchor_same_host_latency_is_monotonic_and_exact():
+    anchors = rt_lat.ClockAnchors()
+    stamp = rt_lat.now_stamp()
+    time.sleep(0.02)
+    lat = anchors.latency_s(stamp)
+    assert 0.015 <= lat < 5.0
+
+
+def test_anchor_skewed_wall_clock_regression():
+    """The skewed-anchor regression (ISSUE satellite): a producer whose
+    WALL clock is stepped minutes off still reports its true monotonic
+    latency — and a cross-boot producer whose wall clock runs AHEAD of
+    the reader's never yields a negative latency (the per-pid floor
+    re-anchors it to zero and keeps later frames honest)."""
+    anchors = rt_lat.ClockAnchors()
+    base = rt_lat.now_stamp()
+    time.sleep(0.01)
+    # Same host, wall stepped +5 min: raw wall delta is -300s; the
+    # shared monotonic clock wins and the latency is exact.
+    skewed = rt_lat.Stamp(base.pid, base.t_mono, base.t_unix + 300.0)
+    lat = anchors.latency_s(skewed)
+    assert 0.0 <= lat < 5.0
+    # Same host, wall stepped -1h: still exact via mono.
+    skewed_back = rt_lat.Stamp(base.pid, base.t_mono,
+                               base.t_unix - 3600.0)
+    assert 0.0 <= anchors.latency_s(skewed_back) < 5.0
+    # Cross-boot pid (mono epoch implausible) with a wall clock 50s
+    # AHEAD: first frame re-anchors to 0, never negative... (the wall
+    # arithmetic below BUILDS the skewed fixtures this regression test
+    # exists for: rsdl-lint: disable=wallclock-interval)
+    ahead = rt_lat.Stamp(4242, base.t_mono + 1e9, time.time() + 50.0)
+    assert anchors.latency_s(ahead) == 0.0
+    # ...and a later frame from the SAME pid that aged 0.2s against
+    # that anchor reports ~0.2s, not -49.8s (deliberate skewed fixture).
+    later_unix = time.time() + 49.8  # rsdl-lint: disable=wallclock-interval
+    later = rt_lat.Stamp(4242, base.t_mono + 1e9, later_unix)
+    lat = anchors.latency_s(later)
+    assert 0.0 <= lat < 5.0
+
+
+# ---------------------------------------------------------------------------
+# Journaled births: crash replays keep their original stamps
+# ---------------------------------------------------------------------------
+
+
+def test_journal_birth_records_round_trip_and_prune(tmp_path):
+    path = str(tmp_path / "wm.wal")
+    journal = ckpt.WatermarkJournal(path)
+    journal.record_birth(3, 0, 111, 10.5, 1.7e9)
+    journal.record_birth(3, 1, 111, 11.5, 1.7e9 + 1)
+    journal.record_birth(3, 2, 112, 12.5, 1.7e9 + 2)
+    journal.close()
+    state = ckpt.WatermarkJournal.load(path)
+    # No watermark yet: an entry materializes at seq -1 (nothing
+    # delivered) carrying every birth; next_seq/skip math read 0.
+    assert state[3].seq == -1
+    assert state[3].births == {0: (111, 10.5, 1.7e9),
+                               1: (111, 11.5, 1.7e9 + 1),
+                               2: (112, 12.5, 1.7e9 + 2)}
+    # An ack watermark prunes the births it covers.
+    journal = ckpt.WatermarkJournal(path)
+    journal.record(3, 1, 200, done=False)
+    journal.close()
+    state = ckpt.WatermarkJournal.load(path)
+    assert state[3].seq == 1
+    assert set(state[3].births) == {2}
+    # Compaction preserves exactly the unacked births.
+    journal = ckpt.WatermarkJournal(path)
+    journal.compact()
+    state = ckpt.WatermarkJournal.load(path)
+    assert state[3].seq == 1 and set(state[3].births) == {2}
+
+
+def test_restored_birth_wins_over_regenerated_stamp(tmp_parquet_dir):
+    """A restarted server regenerating an undelivered item re-attaches
+    the JOURNALED birth, so the delivered frame's latency spans the
+    crash window instead of being laundered recompute-fresh."""
+    filenames, _ = dg.generate_data_local(200, 1, 1, 0.0,
+                                          tmp_parquet_dir)
+    journal_path = str(tmp_parquet_dir) + "/wm.wal"
+
+    def _fill():
+        queue = mq.MultiQueue(1)
+
+        def consumer(rank, epoch, refs):
+            if refs is None:
+                queue.put(0, None)
+            else:
+                queue.put_batch(0, list(refs))
+
+        run_shuffle(filenames, consumer, 1, num_reducers=1,
+                    num_trainers=1, max_concurrent_epochs=1, seed=5,
+                    collect_stats=False, file_cache=None)
+        return queue
+
+    # First incarnation: serve one GET (journals the births), no acks.
+    queue = _fill()
+    journal = ckpt.WatermarkJournal(journal_path)
+    server = svc.serve_queue(queue, num_trainers=1, journal=journal)
+    remote = svc.RemoteQueue(server.address, prefetch=False)
+    table = remote.get(0)
+    assert table is not None
+    remote.close()
+    server.close()
+    journal.close()
+    queue.shutdown()
+    state = ckpt.WatermarkJournal.load(journal_path)
+    original_births = dict(state[0].births)
+    assert 0 in original_births, state
+    # "Crash + restart" 0.4s later: a fresh server with restored state
+    # regenerates the stream; the frame for seq 0 must carry the OLD
+    # birth, so its measured delivery latency includes the gap.
+    time.sleep(0.4)
+    before = rt_metrics.parse_exposition(rt_metrics.render()).get(
+        CENTROID_SERIES, {})
+    queue = _fill()
+    server = svc.serve_queue(queue, num_trainers=1,
+                             initial_state=state)
+    remote = svc.RemoteQueue(server.address, prefetch=False)
+    got = []
+    while True:
+        item = remote.get(0)
+        if item is None:
+            break
+        got.append(item)
+    remote.close()
+    server.close()
+    queue.shutdown()
+    assert len(got) == 1
+    after = rt_metrics.parse_exposition(rt_metrics.render()).get(
+        CENTROID_SERIES, {})
+    spike = 0
+    for labels, value in after.items():
+        d = dict(labels)
+        if (d.get("hop") == rt_lat.HOP_BIRTH_TO_DELIVERED
+                and float(d["c"]) >= 0.3
+                and value - before.get(labels, 0.0) > 0):
+            spike += int(value - before.get(labels, 0.0))
+    assert spike >= 1, "replayed frame did not surface the crash gap"
+
+
+# ---------------------------------------------------------------------------
+# In-process consumer path + live wire path
+# ---------------------------------------------------------------------------
+
+
+def _delta(before, after):
+    return {labels: value - before.get(labels, 0.0)
+            for labels, value in after.items()
+            if value - before.get(labels, 0.0) > 0}
+
+
+def _centroid_samples():
+    return dict(rt_metrics.parse_exposition(rt_metrics.render()).get(
+        CENTROID_SERIES, {}))
+
+
+def test_in_process_dataset_observes_birth_to_delivered(tmp_parquet_dir):
+    filenames, _ = dg.generate_data_local(300, 1, 1, 0.0,
+                                          tmp_parquet_dir)
+    before = _centroid_samples()
+    ds = ShufflingDataset(filenames, 1, num_trainers=1, batch_size=50,
+                          rank=0, seed=11, max_concurrent_epochs=1)
+    ds.set_epoch(0)
+    rows = sum(t.num_rows for t in ds)
+    assert rows == 300
+    delta = _delta(before, _centroid_samples())
+    hops = {dict(labels).get("hop") for labels in delta}
+    assert rt_lat.HOP_BIRTH_TO_DELIVERED in hops
+    fresh = rt_metrics.get("rsdl_delivery_freshness_seconds",
+                           {"queue": "0"})
+    assert fresh is not None and fresh.value >= 0.0
+
+
+def test_served_queue_observes_all_wire_hops(tmp_parquet_dir):
+    """2 trainers over the sharded plane: birth->queued (server side),
+    queued->delivered and birth->delivered (consumer side) all gain
+    non-zero per-rank samples; the single-counting contract holds (the
+    dataset layer must NOT double-observe on top of the wire client)."""
+    filenames, _ = dg.generate_data_local(400, 2, 1, 0.0,
+                                          tmp_parquet_dir)
+    trainers = 2
+    queue = mq.MultiQueue(trainers)
+
+    def consumer(rank, epoch, refs):
+        queue_idx = plan_ir.queue_index(epoch, rank, trainers)
+        if refs is None:
+            queue.put(queue_idx, None)
+        else:
+            queue.put_batch(queue_idx, list(refs))
+
+    run_shuffle(filenames, consumer, 1, num_reducers=2,
+                num_trainers=trainers, max_concurrent_epochs=1, seed=9,
+                collect_stats=False, file_cache=None)
+    before = _centroid_samples()
+    table_frames = 0
+    with svc.serve_queue_sharded(queue, num_shards=2,
+                                 num_trainers=trainers) as sharded:
+        counts = [0, 0]
+        errors = []
+
+        def consume(rank):
+            nonlocal table_frames
+            try:
+                with svc.ShardedRemoteQueue(sharded.shard_map,
+                                            max_batch=2) as remote:
+                    ds = ShufflingDataset(
+                        filenames, 1, num_trainers=trainers,
+                        batch_size=50, rank=rank, batch_queue=remote,
+                        shuffle_result=None, seed=9)
+                    ds.set_epoch(0)
+                    for t in ds.iter_tables():
+                        counts[rank] += t.num_rows
+                        table_frames += 1
+            except BaseException as e:  # noqa: BLE001 - surfaced below
+                errors.append(e)
+
+        threads = [threading.Thread(target=consume, args=(r,))
+                   for r in range(trainers)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        assert not errors, errors
+    queue.shutdown()
+    assert sum(counts) == 400
+    delta = _delta(before, _centroid_samples())
+    by_hop_queue = {}
+    for labels, value in delta.items():
+        d = dict(labels)
+        key = (d.get("hop"), d.get("queue"))
+        by_hop_queue[key] = by_hop_queue.get(key, 0) + int(value)
+    for hop in (rt_lat.HOP_BIRTH_TO_QUEUED,
+                rt_lat.HOP_QUEUED_TO_DELIVERED,
+                rt_lat.HOP_BIRTH_TO_DELIVERED):
+        for rank in ("0", "1"):
+            assert by_hop_queue.get((hop, rank), 0) >= 1, \
+                (hop, rank, by_hop_queue)
+    # Single-counting: consumer-side birth->delivered samples == table
+    # frames delivered (the dataset did not add its own on top).
+    delivered = sum(n for (hop, _q), n in by_hop_queue.items()
+                    if hop == rt_lat.HOP_BIRTH_TO_DELIVERED)
+    assert delivered == table_frames, (delivered, table_frames)
+
+
+# ---------------------------------------------------------------------------
+# SLO detectors: fire exactly once per episode
+# ---------------------------------------------------------------------------
+
+
+def _snap(t, samples):
+    return {"t": t, "t_unix": 1.7e9 + t, "samples": samples}
+
+
+def _centroid_labels(c, queue="0", hop="birth_to_delivered"):
+    return (("c", str(c)), ("hop", hop), ("queue", queue))
+
+
+def test_delivery_latency_breach_fires_once_per_episode():
+    from ray_shuffling_data_loader_tpu.runtime import history as rt_history
+    ring = rt_history.HistoryRing(capacity=400, interval_s=0.1)
+    fired = []
+    mon = rt_health.HealthMonitor(
+        ring,
+        detectors=rt_health.default_detectors(
+            names=["delivery_latency_breach"],
+            slo_delivery_p99_s=1.0, slo_droop_window_ticks=3),
+        fire_ticks=2, clear_ticks=4, capture=False,
+        on_fire=lambda v: fired.append(v))
+    fast, slow, t = 0, 0, 0.0
+    # Healthy: all mass at 10ms.
+    for _ in range(8):
+        fast, t = fast + 5, t + 0.1
+        ring.append_snapshot(_snap(t, {CENTROID_SERIES: {
+            _centroid_labels(0.01): float(fast)}}))
+        mon.tick()
+    assert mon.total_fires == 0
+    # Replay episode: new frames land at ~5s, p99 blows the 1s SLO.
+    for _ in range(6):
+        slow, t = slow + 5, t + 0.1
+        ring.append_snapshot(_snap(t, {CENTROID_SERIES: {
+            _centroid_labels(0.01): float(fast),
+            _centroid_labels(5.0): float(slow)}}))
+        mon.tick()
+    assert mon.total_fires == 1, mon.summary()
+    assert fired[0]["detector"] == "delivery_latency_breach"
+    # Episode persists: no re-fire while still breaching.
+    for _ in range(4):
+        slow, t = slow + 5, t + 0.1
+        ring.append_snapshot(_snap(t, {CENTROID_SERIES: {
+            _centroid_labels(0.01): float(fast),
+            _centroid_labels(5.0): float(slow)}}))
+        mon.tick()
+    assert mon.total_fires == 1
+
+
+def test_freshness_stall_counts_frozen_gauge_age():
+    from ray_shuffling_data_loader_tpu.runtime import history as rt_history
+    ring = rt_history.HistoryRing(capacity=400, interval_s=0.1)
+    fired = []
+    mon = rt_health.HealthMonitor(
+        ring,
+        detectors=rt_health.default_detectors(
+            names=["freshness_stall"], slo_freshness_s=5.0),
+        fire_ticks=2, clear_ticks=3, capture=False,
+        on_fire=lambda v: fired.append(v))
+    labels = (("queue", "0"),)
+    t = 0.0
+    # Fresh deliveries: gauge keeps changing, small ages.
+    for i in range(6):
+        t += 1.0
+        ring.append_snapshot(_snap(t, {
+            "rsdl_delivery_freshness_seconds": {
+                labels: 0.2 + 0.01 * i}}))
+        mon.tick()
+    assert mon.total_fires == 0
+    # Deliveries STOP: the gauge freezes at 0.25s — a naive threshold
+    # on the raw value would never fire; the effective age (value +
+    # frozen-for seconds) crosses 5s and fires exactly once.
+    for _ in range(8):
+        t += 1.0
+        ring.append_snapshot(_snap(t, {
+            "rsdl_delivery_freshness_seconds": {labels: 0.25}}))
+        mon.tick()
+    assert mon.total_fires == 1, mon.summary()
+    assert fired[0]["detector"] == "freshness_stall"
+
+
+def test_rsdl_top_latency_line_and_federated_exposition(tmp_path):
+    """The per-queue latency line renders from the FEDERATED exposition
+    (sketch series survive the shard write/merge path), and the
+    --check-latency self-test passes — the format.sh wiring."""
+    import importlib.util
+    import os
+    repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "_rsdl_top_lat", os.path.join(repo_root, "tools", "rsdl_top.py"))
+    rsdl_top = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(rsdl_top)
+
+    rt_metrics.sketch(DELIVERY, "lat", hop="birth_to_delivered",
+                      queue="3").observe(0.025)
+    rt_lat.set_freshness("3", 1.25)
+    rt_metrics.write_shard(str(tmp_path))
+    shards = rt_metrics.read_shards(str(tmp_path))
+    merged, _ = rt_metrics.merge_series(list(shards.values()))
+    stats = rt_metrics.sketch_quantiles(merged, DELIVERY,
+                                        hop="birth_to_delivered",
+                                        queue="3")
+    assert stats and all(entry["p99"] > 0
+                         for entry in stats.values())
+    text = rsdl_top.render(merged)
+    assert "delivery latency" in text
+    assert "queue 3:" in text and "fresh 1.2" in text
+    assert rsdl_top.check_latency() == 0
+
+
+def test_latency_metrics_are_cataloged():
+    from ray_shuffling_data_loader_tpu.runtime.metric_names import (
+        METRIC_NAMES)
+    assert METRIC_NAMES["rsdl_delivery_latency_seconds"] == (
+        "sketch", ("hop", "queue"))
+    assert METRIC_NAMES["rsdl_delivery_freshness_seconds"] == (
+        "gauge", ("queue",))

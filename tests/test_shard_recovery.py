@@ -177,6 +177,101 @@ def test_shard_journals_are_disjoint_and_resume_restricted(tmp_path):
     assert start_all == 0
 
 
+def test_shard_kill9_replay_surfaces_latency_spike(tmp_parquet_dir):
+    """Latency-through-replay (delivery-latency plane): kill -9 a queue
+    shard after its rank's stream was served once unacked; the
+    restarted incarnation regenerates the stream with the JOURNALED
+    original births, so a crash-resumed consumer sees (a) the exact
+    same tables at the exact same row offsets — seqs/CRCs bit-identical,
+    exactly-once untouched — while (b) the birth->delivered sketch
+    records the replay at its TRUE crash-spanning latency instead of a
+    recompute-fresh one."""
+    from ray_shuffling_data_loader_tpu.runtime import latency as rt_lat
+    from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+
+    trainers, epochs, reducers, seed = 2, 1, 4, 21
+    filenames, _ = dg.generate_data_local(600, 2, 1, 0.0, tmp_parquet_dir)
+    supervisors, shard_map = rt_sup.launch_supervised_queue_shards(dict(
+        filenames=filenames, num_epochs=epochs, num_trainers=trainers,
+        num_reducers=reducers, seed=seed, max_concurrent_epochs=1,
+        journal_path=os.path.join(tmp_parquet_dir, "wm-latency.wal"),
+        file_cache=None), num_shards=2)
+
+    centroid_series = "rsdl_delivery_latency_seconds_centroid"
+
+    def _samples():
+        return dict(rt_metrics.parse_exposition(rt_metrics.render()).get(
+            centroid_series, {}))
+
+    def _delivered_mass(before, after, min_latency_s):
+        """birth->delivered observations in (before, after] at or above
+        ``min_latency_s``, and the total count."""
+        slow = total = 0
+        for labels, value in after.items():
+            d = dict(labels)
+            if d.get("hop") != rt_lat.HOP_BIRTH_TO_DELIVERED:
+                continue
+            delta = int(value - before.get(labels, 0.0))
+            if delta <= 0:
+                continue
+            total += delta
+            if float(d["c"]) >= min_latency_s:
+                slow += delta
+        return slow, total
+
+    def _drain(ack_mode):
+        """One fresh consumer draining rank 0's epoch-0 stream; returns
+        ``[(row_offset, keys)]`` — frame identity plus payload."""
+        stream = []
+        with svc.ShardedRemoteQueue(shard_map, retries=12, max_batch=4,
+                                    ack_mode=ack_mode) as remote:
+            queue_idx = plan_ir.queue_index(0, 0, trainers)
+            while True:
+                item, row_offset = remote.get_positioned(queue_idx)
+                if item is None:
+                    break
+                stream.append((row_offset,
+                               tuple(item.column("key").to_pylist())))
+        return stream
+
+    try:
+        for address in shard_map.addresses:
+            assert rt_sup.wait_for_server(tuple(address), timeout_s=60)
+        base = _samples()
+        # First pass: manual-ack, never committed — everything stays
+        # unacked, and every table frame's birth is journaled at build.
+        first = _drain("manual")
+        assert first
+        after_first = _samples()
+        # A real SIGKILL, then a visible gap the replay must span.
+        os.kill(supervisors[0].pid, signal.SIGKILL)
+        time.sleep(0.6)
+        assert rt_sup.wait_for_server(tuple(shard_map.addresses[0]),
+                                      timeout_s=60)
+        # Crash-resumed consumer: the unacked stream replays in full.
+        second = _drain("delivered")
+        after_second = _samples()
+    finally:
+        for supervisor in supervisors:
+            supervisor.stop()
+
+    assert supervisors[0].restarts >= 1
+    # (a) Exactly-once identity: same tables, same absolute offsets.
+    assert second == first
+    # (b) The replay is visible as a latency spike: pre-kill deliveries
+    # were fast; post-kill re-deliveries carry their ORIGINAL births,
+    # so every replayed frame's latency spans the kill->redelivery gap.
+    slow_before, total_before = _delivered_mass(base, after_first, 0.3)
+    assert total_before >= len(first)
+    # Pre-kill the stream is served live; at most a straggler or two
+    # should sit past 0.3s even on a loaded CI host.
+    assert slow_before < len(first), "pre-kill stream already slow"
+    slow_after, total_after = _delivered_mass(after_first, after_second,
+                                              0.3)
+    assert total_after >= len(second)
+    assert slow_after >= len(second), (slow_after, len(second))
+
+
 @pytest.mark.slow
 def test_shard_kill9_repeated_across_epochs(tmp_parquet_dir):
     """Slow soak: kill the same shard in BOTH epochs; the journal +

@@ -65,6 +65,16 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
     # wide margin (the >= 10x acceptance ratio, with noise headroom).
     {"key": "serve_handle_wire_reduction_x", "mode": "lower_bad",
      "pct": 50.0},
+    # Delivery-latency leg (runtime/latency.py): end-to-end
+    # birth->delivered p99 over the sharded serving plane, and the
+    # birth->device freshness p99. Latency on a shared 1-core host is
+    # noisy, so the relative threshold is wide and the absolute slack
+    # (ms) absorbs scheduler jitter; a real regression (a serialization
+    # copy creeping back in, a replay-path stall) blows through both.
+    {"key": "delivery_p99_ms", "mode": "higher_bad", "pct": 150.0,
+     "slack": 100.0},
+    {"key": "freshness_p99_ms", "mode": "higher_bad", "pct": 150.0,
+     "slack": 150.0},
 ]
 
 

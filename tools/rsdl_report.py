@@ -345,6 +345,51 @@ def _section_health(manifest, records) -> str:
     return "".join(parts)
 
 
+def _section_latency(records, ring, manifest) -> str:
+    """Delivery latency & freshness: the bench latency leg's headline
+    p99s, the capsule's frozen per-queue quantiles, and a freshness
+    sparkline (worst queue per tick) from the history ring."""
+    parts = []
+    latest = records[-1][1] if records else {}
+    rows = []
+    for key, label in (("delivery_p50_ms", "delivery p50"),
+                       ("delivery_p95_ms", "delivery p95"),
+                       ("delivery_p99_ms", "delivery p99"),
+                       ("freshness_p99_ms", "freshness p99")):
+        if latest.get(key) is not None:
+            rows.append((html.escape(label), _fmt(latest.get(key)),
+                         "ms"))
+    capsule_latency = (manifest or {}).get("latency") or {}
+    fresh_pts = []
+    if ring is not None:
+        for snap in ring.snapshots():
+            series = snap["samples"].get(
+                "rsdl_delivery_freshness_seconds")
+            if series:
+                fresh_pts.append((snap["t"], max(series.values())))
+    if not rows and not capsule_latency and len(fresh_pts) < 2:
+        return ""
+    parts.append("<h2>Delivery latency &amp; freshness</h2>")
+    if rows:
+        parts.append("<p class='sub'>bench latency leg "
+                     "(birth→delivered / birth→device)</p>")
+        parts.append(_table(("span", "value", "unit"), rows))
+    if capsule_latency:
+        parts.append("<p class='sub'>capsule snapshot — per hop/queue "
+                     "(seconds)</p>")
+        parts.append(_table(
+            ("series", "p50", "p95", "p99", "n"),
+            [(html.escape(key), _fmt(entry.get("p50")),
+              _fmt(entry.get("p95")), _fmt(entry.get("p99")),
+              _fmt(entry.get("count")))
+             for key, entry in sorted(capsule_latency.items())]))
+    if len(fresh_pts) >= 2:
+        parts.append("<p class='sub'>freshness — worst queue's payload "
+                     "age at the consumer's last hop</p>")
+        parts.append(spark_svg(fresh_pts, unit="s"))
+    return "".join(parts)
+
+
 def _section_scaling(records) -> str:
     latest = records[-1][1] if records else None
     scaling = (latest or {}).get("worker_scaling")
@@ -376,6 +421,7 @@ def build_html(records, ring, traced, manifest) -> str:
         "<h1>rsdl run report</h1>"
         f"<p class='sub'>{html.escape(' · '.join(str(s) for s in sub))}</p>"
         + _section_health(manifest, records)
+        + _section_latency(records, ring, manifest)
         + _section_history(ring)
         + _section_traces(traced)
         + _section_scaling(records)

@@ -204,6 +204,94 @@ def render_shards(parsed: dict) -> list:
     return lines
 
 
+def render_latency(parsed: dict, before: dict = None) -> list:
+    """Per-queue delivery-latency lines (runtime/latency.py sketch):
+    p50/p95/p99 of the end-to-end birth->delivered hop plus the queue's
+    freshness gauge. In refresh mode the quantiles are computed over the
+    INTERVAL's centroid deltas (cumulative counts subtract exactly);
+    ``--once`` shows lifetime quantiles."""
+    series = "rsdl_delivery_latency_seconds_centroid"
+    now = parsed.get(series, {})
+    if not now:
+        return []
+    if before is not None:
+        base = before.get(series, {})
+        now = {labels: value - base.get(labels, 0.0)
+               for labels, value in now.items()
+               if value - base.get(labels, 0.0) > 0}
+        if not now:
+            return []
+    stats = _metrics.sketch_quantiles(
+        {series: now}, "rsdl_delivery_latency_seconds",
+        hop="birth_to_delivered")
+    if not stats:
+        return []
+    fresh = _by_label(parsed, "rsdl_delivery_freshness_seconds", "queue")
+    lines = ["delivery latency (birth->delivered):"]
+    for labels, entry in sorted(stats.items()):
+        queue = dict(labels).get("queue", "?")
+        line = (f"  queue {queue}: p50 {entry['p50'] * 1e3:7.1f}ms  "
+                f"p95 {entry['p95'] * 1e3:7.1f}ms  "
+                f"p99 {entry['p99'] * 1e3:7.1f}ms  "
+                f"n {int(entry['count'])}")
+        if queue in fresh:
+            line += f"  fresh {fresh[queue]:.1f}s"
+        lines.append(line)
+    return lines
+
+
+def check_latency() -> int:
+    """Sketch merge self-test (``--check-latency``, wired into
+    format.sh's informational block): observe disjoint values in two
+    registries, render -> parse -> federation-merge, and require the
+    merged quantiles to equal a directly-merged sketch's — so a schema
+    drift anywhere in the sketch's shard exposition (series suffix,
+    centroid label, merge math) fails fast, before a real run's p99
+    silently reads wrong."""
+    name = "rsdl_delivery_latency_seconds"
+    values_a = [0.002, 0.004, 0.008, 0.05]
+    values_b = [0.1, 0.9, 2.0]
+    regs = [_metrics.Registry(), _metrics.Registry()]
+    for reg, values in zip(regs, (values_a, values_b)):
+        sk = reg.sketch(name, "self-test", hop="birth_to_delivered",
+                        queue="0")
+        for v in values:
+            sk.observe(v)
+    shards = [_metrics.parse_exposition_typed(reg.render())
+              for reg in regs]
+    merged, types = _metrics.merge_series(shards)
+    if types.get(name) != "sketch":
+        print(f"check-latency: TYPE line lost (got {types.get(name)!r})")
+        return 1
+    stats = _metrics.sketch_quantiles(merged, name)
+    direct = _metrics.Sketch()
+    for v in values_a + values_b:
+        direct.observe(v)
+    for labels, entry in stats.items():
+        if int(entry["count"]) != direct.count:
+            print(f"check-latency: merged count {entry['count']} != "
+                  f"direct {direct.count}")
+            return 1
+        for q, key in ((0.5, "p50"), (0.95, "p95"), (0.99, "p99")):
+            if abs(entry[key] - direct.percentile(q)) > 1e-12:
+                print(f"check-latency: merged {key} {entry[key]} != "
+                      f"direct {direct.percentile(q)}")
+                return 1
+    if not stats:
+        print("check-latency: no sketch series survived the round-trip")
+        return 1
+    # The merged exposition must itself round-trip (the federation file
+    # and HTTP endpoint serve render_merged output).
+    reparsed, _ = _metrics.parse_exposition_typed(
+        _metrics.render_merged(merged, types))
+    if reparsed.get(f"{name}_centroid") != merged.get(f"{name}_centroid"):
+        print("check-latency: render_merged did not round-trip")
+        return 1
+    print("check-latency: sketch merge/exposition round-trip OK "
+          f"(p99 {direct.percentile(0.99)}s over {direct.count} samples)")
+    return 0
+
+
 def render(parsed: dict, before: dict = None, interval_s: float = None
            ) -> str:
     """One table: per-stage events/s (or totals), busy share, p95."""
@@ -265,6 +353,8 @@ def render(parsed: dict, before: dict = None, interval_s: float = None
             f"frames replayed: {int(replayed)}   "
             f"server restarts: {int(restarts)}")
     lines.extend(render_shards(parsed))
+    lines.extend(render_latency(parsed, before=before if rate_mode
+                                else None))
     # Critical-path line (runtime/trace.py gauges, refreshed per epoch):
     # the top-3 stages by critical-path self time plus the current
     # straggler task — the "what do I optimize" one-liner.
@@ -310,7 +400,12 @@ def main(argv=None) -> int:
                         help="refresh seconds (default 2)")
     parser.add_argument("--once", action="store_true",
                         help="print one lifetime-totals snapshot and exit")
+    parser.add_argument("--check-latency", action="store_true",
+                        help="run the latency-sketch merge/exposition "
+                             "self-test and exit (0 = OK)")
     args = parser.parse_args(argv)
+    if args.check_latency:
+        return check_latency()
     if not args.file and not args.url and not args.dir:
         parser.error("need --file, --url or --dir "
                      "(or set RSDL_METRICS_FILE / RSDL_TELEMETRY_DIR)")
