@@ -676,15 +676,41 @@ def run_train(jax, filenames, *, num_epochs, batch_size, num_reducers,
                          prefetch_size=prefetch_size, cold=False,
                          device_rebatch=device_rebatch,
                          qname=f"{qname}-warm")
+    last_chunk = None
     try:
         warm.set_epoch(0)
         loss = None
         for features, label in warm:
             params, opt_state, loss = chunk_steps(
                 params, opt_state, features, label)
+            last_chunk = (features, label)
         jax.block_until_ready(loss)
     finally:
         warm.close()
+
+    # Measured model peak: pure-compute rows/s of the SAME jitted step
+    # loop on one already-device-resident warm chunk — no pipeline, no
+    # transfer, no batch wait. When the device has no public peak-FLOPs
+    # entry (CPU hosts, unlisted accelerators), train_mfu reports
+    # achieved/compute-bound instead of going silently null: 100% means
+    # the input pipeline kept the step loop fully fed. Params advance on
+    # a throwaway copy so the timed run starts from the same state as
+    # before this measurement existed.
+    compute_rows_per_s = None
+    if last_chunk is not None:
+        warm_f, warm_l = last_chunk
+        pm, om, lm = chunk_steps(params, opt_state, warm_f, warm_l)
+        jax.block_until_ready(lm)
+        best_s = None
+        for _ in range(3):
+            peak_t0 = timeit.default_timer()
+            pm, om, lm = chunk_steps(pm, om, warm_f, warm_l)
+            jax.block_until_ready(lm)
+            rep_s = timeit.default_timer() - peak_t0
+            best_s = rep_s if best_s is None else min(best_s, rep_s)
+        # Fastest rep, not the mean: the peak is a CAPACITY estimate, and
+        # any jitter in the reps only ever makes it look lower.
+        compute_rows_per_s = batch_size / max(best_s, 1e-9)
 
     launch = timeit.default_timer()
     ds = _make_dataset(filenames, num_epochs=num_epochs,
@@ -727,14 +753,30 @@ def run_train(jax, filenames, *, num_epochs, batch_size, num_reducers,
     # embedding/memory-bound, the MLP widths just bound the MXU share.
     peak = _device_peak_flops(jax)
     flops_per_row = _train_flops_per_row(cfg)
-    mfu_pct = (100.0 * flops_per_row * rows_consumed / (duration * peak)
-               if peak else None)
+    if peak:
+        mfu_pct = 100.0 * flops_per_row * rows_consumed / (duration * peak)
+        mfu_basis, mfu_null_reason = "public_peak", None
+    elif compute_rows_per_s:
+        # No public peak for this device kind: report achieved rows/s
+        # against the measured compute-bound ceiling of the identical
+        # step loop. Not comparable to a public-peak MFU — the basis
+        # field says which denominator produced the number.
+        mfu_pct = 100.0 * (rows_consumed / duration) / compute_rows_per_s
+        mfu_basis, mfu_null_reason = "measured_model_peak", None
+    else:
+        mfu_pct, mfu_basis = None, None
+        mfu_null_reason = ("device kind has no public peak-FLOPs entry "
+                           "and the warm-up delivered no chunk to measure "
+                           "a model peak against")
     return {
         "rows_per_s": rows_consumed / duration,
         "stall_s": stall_s,
         "stall_pct": 100.0 * stall_s / duration,
         "dev_util_pct": 100.0 * (duration - stall_s) / duration,
         "mfu_pct": mfu_pct,
+        "mfu_basis": mfu_basis,
+        "mfu_null_reason": mfu_null_reason,
+        "compute_rows_per_s": compute_rows_per_s,
         "flops_per_row": flops_per_row,
         "wait_mean_ms": wait["mean"] * 1e3,
         # Mean train-step time the pipeline had to beat: everything that
@@ -1981,6 +2023,11 @@ def main() -> None:
             "train_dev_util_pct": round(train["dev_util_pct"], 3),
             "train_mfu_pct": (round(train["mfu_pct"], 4)
                               if train["mfu_pct"] is not None else None),
+            "train_mfu_basis": train.get("mfu_basis"),
+            "train_mfu_null_reason": train.get("mfu_null_reason"),
+            "train_compute_rows_per_sec": (
+                round(train["compute_rows_per_s"], 1)
+                if train.get("compute_rows_per_s") else None),
             "train_flops_per_row": train["flops_per_row"],
             "train_wait_mean_ms": round(train["wait_mean_ms"], 3),
             "train_fill_s": round(train.get("fill_s", 0.0), 3),

@@ -25,6 +25,16 @@ drift).
   path right before a table enters a cross-epoch cache); per-call sites
   must operate on the chunked form instead.
 
+``sendall-in-loop`` pins the wire-syscall discipline that made the
+sendmsg scatter-gather path worth building: a ``.sendall`` call inside a
+``for`` loop writes one syscall per buffer, when the loop is almost
+always walking a collection of frames/chunks that could gather into a
+single ``sendmsg`` (``multiqueue_service._sendmsg_all``). ``while``
+protocol loops (heartbeats, request/response) are deliberately excused —
+one logical message per iteration is not a gatherable batch. The legacy
+sequential arm kept for ``RSDL_QUEUE_SENDMSG=0`` carries pragmas: it IS
+the fallback the rule exists to keep rare.
+
 Escape hatch: ``# rsdl-lint: disable=copy-in-hot-path`` on the line (or
 the line above), with the justification in prose next to it — the
 pragma IS the blessing mechanism.
@@ -92,6 +102,37 @@ class CopyInHotPathRule(Rule):
                     "into fresh buffers; bless only once-per-cache-entry "
                     "sites (pragma + justification) — per-call sites must "
                     "stay chunked")
+
+
+@register
+class SendallInLoopRule(Rule):
+    id = "sendall-in-loop"
+    category = "perf"
+    description = ("flag `.sendall(...)` inside a for loop — one syscall "
+                   "per buffer where a sendmsg scatter-gather batch would "
+                   "write the whole collection in one; while-loop protocol "
+                   "exchanges are excused")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        seen = set()
+        for loop in ast.walk(tree):
+            if not isinstance(loop, ast.For):
+                continue
+            for node in ast.walk(loop):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "sendall"
+                        and id(node) not in seen):
+                    seen.add(id(node))
+                    yield ctx.violation(
+                        self, node,
+                        "`.sendall` inside a for loop pays one syscall per "
+                        "buffer; gather the iteration's buffers and write "
+                        "them with one scatter-gather sendmsg "
+                        "(multiqueue_service._sendmsg_all), or bless a "
+                        "deliberate sequential fallback with a pragma + "
+                        "justification")
 
 
 def _is_bytes_init(value: ast.expr) -> bool:

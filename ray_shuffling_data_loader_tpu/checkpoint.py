@@ -23,7 +23,6 @@ import json
 import os
 import tempfile
 import threading
-import zlib
 from typing import Dict, Iterator, Optional
 
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
@@ -133,8 +132,9 @@ class WatermarkJournal:
 
     @staticmethod
     def _encode(entry: dict) -> str:
+        from ray_shuffling_data_loader_tpu import native
         body = json.dumps(entry, sort_keys=True, separators=(",", ":"))
-        crc = zlib.crc32(body.encode()) & 0xFFFFFFFF
+        crc = native.crc32(body.encode()) & 0xFFFFFFFF
         return json.dumps({"crc": crc, "entry": entry}, sort_keys=True,
                           separators=(",", ":"))
 
@@ -176,6 +176,7 @@ class WatermarkJournal:
     def load(cls, path: str) -> Dict[int, WatermarkEntry]:
         """Latest watermark per queue index; lines with a bad/missing
         CRC (torn tail) are skipped with a warning."""
+        from ray_shuffling_data_loader_tpu import native
         state: Dict[int, WatermarkEntry] = {}
         births: Dict[int, Dict[int, tuple]] = \
             collections.defaultdict(dict)
@@ -191,7 +192,7 @@ class WatermarkJournal:
                     entry = record["entry"]
                     body = json.dumps(entry, sort_keys=True,
                                       separators=(",", ":"))
-                    if zlib.crc32(body.encode()) & 0xFFFFFFFF != \
+                    if native.crc32(body.encode()) & 0xFFFFFFFF != \
                             record["crc"]:
                         raise ValueError("crc mismatch")
                     queue_index = int(entry["q"])

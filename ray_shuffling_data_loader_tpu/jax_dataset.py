@@ -23,6 +23,7 @@ waiting for a batch (reference: ray_torch_shuffle.py:186-218) — in
 
 from __future__ import annotations
 
+import concurrent.futures as cf
 import itertools
 import queue as _queue
 import threading
@@ -175,6 +176,11 @@ class CastTransform:
 
     __slots__ = ("targets",)
 
+    #: Per-row independent and row-count preserving: the fused streaming
+    #: map pipeline (shuffle._fused_stream_columns) may apply this per
+    #: record batch instead of per file — same bytes either way.
+    row_elementwise = True
+
     def __init__(self, targets):
         self.targets = dict(targets)
 
@@ -283,6 +289,16 @@ class _BatchConverter:
         self.bulk_transfer_deadline_s = bulk_transfer_deadline_s
         self.stall_action = stall_action
         self.fallback_engaged = False  # a stall degraded the bulk path
+        # Double-buffered device staging (RSDL_DEVICE_DOUBLE_BUFFER,
+        # default on): the per-batch producer dispatches batch N's
+        # host->device transfer on a staging thread while it converts
+        # batch N+1 — upload overlaps host work, FIFO order preserved.
+        # The owning JaxShufflingDataset overrides this from its resolved
+        # runtime_policy; the resolve here covers direct converter use.
+        from ray_shuffling_data_loader_tpu.runtime import (policy as
+                                                           rt_policy)
+        self.double_buffer = bool(
+            rt_policy.resolve("jax_dataset", "device_double_buffer"))
         self._slicer = {}  # batch_size -> jitted batch slicer, built lazily
         # Transient device-transfer failures (tunnel hiccup, injected
         # `device_transfer` fault) are retried in place: the source arrays
@@ -528,6 +544,10 @@ def _persistent_producer(dataset: ShufflingDataset,
                 if not _produce_epoch_tables(dataset, converter, epoch, put,
                                              queue_depth=out.qsize):
                     return
+            elif converter.double_buffer:
+                if not _produce_epoch_batches_staged(dataset, converter,
+                                                     epoch, put):
+                    return
             else:
                 for table in dataset:
                     with trace_span("batch_convert", kind="convert",
@@ -542,6 +562,40 @@ def _persistent_producer(dataset: ShufflingDataset,
                 return
     except BaseException as e:  # noqa: BLE001 - forwarded to consumer
         put(e)
+
+
+def _staged_transfer(converter: _BatchConverter, arrays, epoch):
+    """One per-batch host->device transfer on the staging thread — the
+    same retried/fault-injected ``converter.transfer`` (and the same
+    telemetry span) the serial path runs inline."""
+    with trace_span("batch_transfer", kind="device_transfer", epoch=epoch):
+        return converter.transfer(arrays)
+
+
+def _produce_epoch_batches_staged(dataset, converter: _BatchConverter,
+                                  epoch: int, put) -> bool:
+    """Per-batch producer loop with double-buffered device staging
+    (``RSDL_DEVICE_DOUBLE_BUFFER``, default on): batch N's transfer is
+    dispatched on a one-thread staging pool while this thread fetches
+    and converts batch N+1, overlapping host->device upload with host
+    decode/convert. Exactly one transfer is in flight and results are
+    awaited FIFO, so delivery order, chaos draws and retry semantics are
+    those of the serial path. Returns False when the consumer is gone."""
+    with cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="rsdl-device-stage") as pool:
+        pending = None
+        for table in dataset:
+            with trace_span("batch_convert", kind="convert", epoch=epoch):
+                arrays = converter.convert(table)
+            fut = pool.submit(_staged_transfer, converter, arrays, epoch)
+            if pending is not None and not put(
+                    ("batch", epoch, pending.result())):
+                return False
+            pending = fut
+        if pending is not None and not put(
+                ("batch", epoch, pending.result())):
+            return False
+    return True
 
 
 # Upper bound on batches per bulk device chunk: caps both the jit slicer's
@@ -978,6 +1032,8 @@ class JaxShufflingDataset:
         # freshness_stall detector's series).
         self._converter.latency_probe = rt_latency.LatencyProbe(
             queue=str(self._dataset.rank))
+        self._converter.double_buffer = bool(
+            self._runtime_policy["device_double_buffer"])
         self.batch_wait_stats = BatchWaitStats()
         # Persistent-prefetch state (one producer thread for ALL epochs).
         self._persistent = persistent_prefetch
@@ -1278,15 +1334,25 @@ class JaxShufflingDataset:
         def producer():
             epoch = getattr(self._dataset, "_epoch", None)
             try:
-                for table in self._dataset:
-                    with trace_span("batch_convert", kind="convert",
-                                    epoch=epoch):
-                        arrays = self._convert(table)
-                    with trace_span("batch_transfer",
-                                    kind="device_transfer", epoch=epoch):
-                        batch = self._transfer(arrays)
-                    if not _put(batch):
+                if self._converter.double_buffer:
+                    # Double-buffered staging (same shape as the
+                    # persistent producer's): transfer N overlaps
+                    # convert N+1, delivered FIFO.
+                    if not _produce_epoch_batches_staged(
+                            self._dataset, self._converter, epoch,
+                            lambda item: _put(item[2])):
                         return
+                else:
+                    for table in self._dataset:
+                        with trace_span("batch_convert", kind="convert",
+                                        epoch=epoch):
+                            arrays = self._convert(table)
+                        with trace_span("batch_transfer",
+                                        kind="device_transfer",
+                                        epoch=epoch):
+                            batch = self._transfer(arrays)
+                        if not _put(batch):
+                            return
                 _put(SENTINEL)
             except BaseException as e:  # noqa: BLE001 - forwarded to consumer
                 _put(e)

@@ -63,6 +63,14 @@ def _bind(lib: ctypes.CDLL) -> None:
     lib.rsdl_plan_partition.argtypes = [i64, i64, u64, i64p, i64p,
                                         ctypes.c_int]
     lib.rsdl_plan_partition.restype = ctypes.c_int
+    lib.rsdl_partition_counts.argtypes = [i64, i64, u64, i64, i64p,
+                                          ctypes.c_int]
+    lib.rsdl_partition_counts.restype = ctypes.c_int
+    lib.rsdl_assign_dest.argtypes = [i64, i64, u64, i64, i64p,
+                                     ctypes.POINTER(ctypes.c_int32)]
+    lib.rsdl_assign_dest.restype = ctypes.c_int
+    lib.rsdl_crc32.argtypes = [ctypes.c_void_p, i64, ctypes.c_uint32]
+    lib.rsdl_crc32.restype = ctypes.c_uint32
     lib.rsdl_scatter_gather.argtypes = [
         ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
         i64, ctypes.c_int32, ctypes.c_int
@@ -137,6 +145,61 @@ def available() -> bool:
     return _load() is not None
 
 
+_crc_backend_cached: Optional[str] = None
+
+
+def crc_backend() -> str:
+    """The resolved CRC backend: ``"native"`` or ``"zlib"``.
+
+    Policy knob ``crc_backend`` (env ``RSDL_CRC_BACKEND``): ``auto``
+    (default — native when the library is loaded), ``native``, ``zlib``.
+    Resolved once per process (the wire path calls :func:`crc32` per
+    frame); tests flip backends via :func:`reset_crc_backend`. An explicit
+    ``native`` request without a loaded library degrades to zlib — the
+    checksums are bit-identical, so integrity is never at stake, only
+    speed.
+    """
+    global _crc_backend_cached
+    if _crc_backend_cached is None:
+        from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
+        choice = rt_policy.resolve("native", "crc_backend")
+        if choice == "zlib":
+            _crc_backend_cached = "zlib"
+        else:
+            _crc_backend_cached = "native" if available() else "zlib"
+    return _crc_backend_cached
+
+
+def reset_crc_backend() -> None:
+    """Drop the cached backend choice (test hook for env flips)."""
+    global _crc_backend_cached
+    _crc_backend_cached = None
+
+
+def crc32(data, value: int = 0) -> int:
+    """``zlib.crc32``-compatible checksum over any contiguous buffer.
+
+    Same polynomial, same init/running-value semantics as ``zlib.crc32``
+    (``crc = crc32(chunk, crc)`` chains), so every recorded checksum —
+    wire frames, spill files, shm segments, watermark journals — stays
+    valid across backend switches. The native kernel (slice-by-8 tables,
+    ARMv8 ``crc32`` intrinsics where available) runs without the GIL.
+    """
+    import zlib
+    if crc_backend() != "native":
+        return zlib.crc32(data, value)
+    try:
+        buf = np.frombuffer(data, dtype=np.uint8)
+    except ValueError:  # non-contiguous / exotic buffer: zlib handles it
+        return zlib.crc32(data, value)
+    if buf.nbytes == 0:
+        return value & 0xFFFFFFFF
+    lib = _load()
+    assert lib is not None
+    return int(lib.rsdl_crc32(buf.ctypes.data, buf.nbytes,
+                              value & 0xFFFFFFFF))
+
+
 def partition_indices(assignments: np.ndarray,
                       num_reducers: int) -> List[np.ndarray]:
     """O(n) stable counting-sort partition (see ops/partition.py docstring)."""
@@ -171,7 +234,8 @@ _MIX_C1 = np.uint64(0xbf58476d1ce4e5b9)
 _MIX_C2 = np.uint64(0x94d049bb133111eb)
 
 
-def hash_assign(num_rows: int, num_reducers: int, key: int) -> np.ndarray:
+def hash_assign(num_rows: int, num_reducers: int, key: int,
+                row0: int = 0) -> np.ndarray:
     """Vectorized splitmix64 per-row reducer assignment.
 
     Bit-identical to the per-row hash inside the native
@@ -180,11 +244,13 @@ def hash_assign(num_rows: int, num_reducers: int, key: int) -> np.ndarray:
     fallback and the fused native plan produce the same partition on any
     host. Counter-based on purpose: every row's draw is independent, which
     is what lets the native kernel recompute assignments in its placement
-    pass instead of materializing them.
+    pass instead of materializing them — and what lets the streaming map
+    pipeline draw any batch's slice of the stream via ``row0`` (global row
+    offset of the batch's first row) without touching earlier rows.
     """
     if num_reducers < 1:
         raise ValueError(f"num_reducers must be >= 1, got {num_reducers}")
-    i = np.arange(1, num_rows + 1, dtype=np.uint64)
+    i = np.arange(row0 + 1, row0 + num_rows + 1, dtype=np.uint64)
     x = np.uint64(key & 0xFFFFFFFFFFFFFFFF) + i * _GOLDEN
     x ^= x >> np.uint64(30)
     x *= _MIX_C1
@@ -192,6 +258,47 @@ def hash_assign(num_rows: int, num_reducers: int, key: int) -> np.ndarray:
     x *= _MIX_C2
     x ^= x >> np.uint64(31)
     return (x % np.uint64(num_reducers)).astype(np.uint32)
+
+
+def partition_counts(num_rows: int, num_reducers: int, key: int,
+                     row0: int = 0, nthreads: int = 1) -> np.ndarray:
+    """Per-reducer row counts for ``num_rows`` rows of the ``key`` hash
+    stream starting at global row ``row0`` — no data, no index array
+    (native kernel). Prefix-summing the result gives the exact region
+    offsets the streaming map pipeline scatters into."""
+    lib = _load()
+    assert lib is not None
+    counts = np.empty(num_reducers, dtype=np.int64)
+    rc = lib.rsdl_partition_counts(
+        num_rows, num_reducers, key & 0xFFFFFFFFFFFFFFFF, row0,
+        counts.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        max(1, nthreads))
+    if rc != 0:
+        raise ValueError(
+            f"invalid partition_counts arguments (num_rows={num_rows}, "
+            f"num_reducers={num_reducers})")
+    return counts
+
+
+def assign_dest(num_rows: int, num_reducers: int, key: int, row0: int,
+                cursors: np.ndarray) -> np.ndarray:
+    """Destination slots for one record batch of the streaming map
+    pipeline: ``dest[i] = cursors[assign(row0 + i)]++`` (native kernel,
+    cursors advanced in place). int32 output; raises when a slot exceeds
+    int32 range (callers fall back to the NumPy int64 path)."""
+    lib = _load()
+    assert lib is not None
+    assert cursors.dtype == np.int64 and cursors.flags.c_contiguous
+    dest = np.empty(num_rows, dtype=np.int32)
+    rc = lib.rsdl_assign_dest(
+        num_rows, num_reducers, key & 0xFFFFFFFFFFFFFFFF, row0,
+        cursors.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        dest.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+    if rc != 0:
+        raise ValueError(
+            "assign_dest arguments invalid or destination exceeds int32 "
+            f"(num_rows={num_rows}, num_reducers={num_reducers})")
+    return dest
 
 
 def plan_partition_flat(num_rows: int, num_reducers: int, key: int,

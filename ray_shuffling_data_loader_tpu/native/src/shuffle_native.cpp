@@ -28,6 +28,10 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#if defined(__ARM_FEATURE_CRC32)
+#include <arm_acle.h>
+#endif
+
 extern "C" {
 
 // ---------------------------------------------------------------------------
@@ -153,6 +157,143 @@ int rsdl_plan_partition(int64_t n, int64_t num_reducers, uint64_t key,
     for (auto& th : threads) th.join();
   }
   return 0;
+}
+
+// ---------------------------------------------------------------------------
+// Streaming map pipeline: counts-only plan + per-batch destination assign
+// ---------------------------------------------------------------------------
+//
+// The fused decode->partition->gather map path streams Parquet record
+// batches straight into per-reducer output buffers, so it needs the plan in
+// two pieces instead of one:
+//
+//   1. rsdl_partition_counts — per-reducer row counts for the WHOLE file,
+//      computed from the hash stream alone (no data, no index array): the
+//      assignment is counter-based, so the counts are known before the
+//      first batch is decoded. This sizes the per-reducer output regions.
+//   2. rsdl_assign_dest — for one record batch starting at global row
+//      `row0`, emit each row's destination slot (cursor[r]++ over the
+//      running per-reducer cursors). Rows are visited in increasing global
+//      row order, so every reducer's region fills in original row order —
+//      the same stable order rsdl_plan_partition's counting sort produces,
+//      which is what makes the streamed output bit-identical to the legacy
+//      plan-then-gather path.
+//
+// Both use row_assign() above, i.e. the exact (seed, epoch, file) hash
+// stream of rsdl_plan_partition and the NumPy hash_assign fallback.
+
+int rsdl_partition_counts(int64_t n, int64_t num_reducers, uint64_t key,
+                          int64_t row0, int64_t* out_counts, int nthreads) {
+  if (num_reducers < 1 || n < 0 || row0 < 0) return -1;
+  if (nthreads < 1) nthreads = 1;
+  if (n < (1 << 16)) nthreads = 1;
+  const uint64_t bound = static_cast<uint64_t>(num_reducers);
+  std::vector<std::vector<int64_t>> counts(
+      nthreads, std::vector<int64_t>(num_reducers, 0));
+  auto work = [&](int t) {
+    int64_t lo = n * t / nthreads, hi = n * (t + 1) / nthreads;
+    auto& local = counts[t];
+    for (int64_t i = lo; i < hi; ++i)
+      local[row_assign(key, row0 + i, bound)]++;
+  };
+  if (nthreads == 1) {
+    work(0);
+  } else {
+    std::vector<std::thread> threads;
+    threads.reserve(nthreads);
+    for (int t = 0; t < nthreads; ++t) threads.emplace_back(work, t);
+    for (auto& th : threads) th.join();
+  }
+  for (int64_t r = 0; r < num_reducers; ++r) {
+    int64_t total = 0;
+    for (int t = 0; t < nthreads; ++t) total += counts[t][r];
+    out_counts[r] = total;
+  }
+  return 0;
+}
+
+// Serial on purpose: the cursors advance in strict row order (stability),
+// and a record batch is ~64K rows — at ~1.5 ns/row the loop is far below
+// the decode cost it overlaps with. Returns -1 when a destination slot
+// exceeds int32 range (caller falls back to the 64-bit NumPy path).
+int rsdl_assign_dest(int64_t n, int64_t num_reducers, uint64_t key,
+                     int64_t row0, int64_t* cursors, int32_t* out_dest) {
+  if (num_reducers < 1 || n < 0 || row0 < 0) return -1;
+  const uint64_t bound = static_cast<uint64_t>(num_reducers);
+  for (int64_t i = 0; i < n; ++i) {
+    uint64_t r = row_assign(key, row0 + i, bound);
+    int64_t d = cursors[r]++;
+    if (d > INT32_MAX) return -1;
+    out_dest[i] = static_cast<int32_t>(d);
+  }
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// CRC32 (zlib polynomial)
+// ---------------------------------------------------------------------------
+//
+// zlib.crc32-compatible checksum: reflected ISO-HDLC polynomial 0xEDB88320.
+// The x86 SSE4.2 `crc32` instruction computes CRC-32C (Castagnoli,
+// 0x82F63B78) and can NOT produce zlib-compatible output, so on x86 the
+// fast path is slice-by-8 tables (~8 table lookups per 8 bytes, multi-GB/s,
+// several times zlib's Python-call throughput once the ctypes call runs
+// without the GIL). ARMv8's __crc32* intrinsics implement the zlib
+// polynomial directly and are used when the compiler advertises them.
+
+#if !defined(__ARM_FEATURE_CRC32)
+namespace {
+
+struct Crc32Tables {
+  uint32_t t[8][256];
+  Crc32Tables() {
+    for (uint32_t i = 0; i < 256; ++i) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; ++k)
+        c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      t[0][i] = c;
+    }
+    for (uint32_t i = 0; i < 256; ++i)
+      for (int j = 1; j < 8; ++j)
+        t[j][i] = (t[j - 1][i] >> 8) ^ t[0][t[j - 1][i] & 0xFF];
+  }
+};
+
+const Crc32Tables g_crc;  // 8 KiB, built once at load
+
+}  // namespace
+#endif
+
+uint32_t rsdl_crc32(const void* data, int64_t n, uint32_t init) {
+  const uint8_t* p = static_cast<const uint8_t*>(data);
+  uint32_t c = ~init;
+#if defined(__ARM_FEATURE_CRC32)
+  while (n >= 8) {
+    uint64_t v;
+    std::memcpy(&v, p, 8);
+    c = __crc32d(c, v);
+    p += 8;
+    n -= 8;
+  }
+  while (n-- > 0) c = __crc32b(c, *p++);
+#else
+  // Slice-by-8: two 32-bit little-endian loads per iteration (x86/ARM are
+  // both little-endian; the byte-at-a-time tail is endian-agnostic).
+  while (n >= 8) {
+    uint32_t lo, hi;
+    std::memcpy(&lo, p, 4);
+    std::memcpy(&hi, p + 4, 4);
+    c ^= lo;
+    c = g_crc.t[7][c & 0xFF] ^ g_crc.t[6][(c >> 8) & 0xFF] ^
+        g_crc.t[5][(c >> 16) & 0xFF] ^ g_crc.t[4][c >> 24] ^
+        g_crc.t[3][hi & 0xFF] ^ g_crc.t[2][(hi >> 8) & 0xFF] ^
+        g_crc.t[1][(hi >> 16) & 0xFF] ^ g_crc.t[0][hi >> 24];
+    p += 8;
+    n -= 8;
+  }
+  while (n-- > 0) c = g_crc.t[0][(c ^ *p++) & 0xFF] ^ (c >> 8);
+#endif
+  return ~c;
 }
 
 // ---------------------------------------------------------------------------

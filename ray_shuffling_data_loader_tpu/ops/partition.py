@@ -140,6 +140,57 @@ def plan_partition_flat(num_rows: int, num_reducers: int, seed: int,
     return order, offsets
 
 
+def partition_counts(num_rows: int, num_reducers: int, seed: int,
+                     epoch: int, file_index: int, row0: int = 0,
+                     nthreads: int = 1) -> np.ndarray:
+    """Per-reducer row counts of the file's partition plan, with NO data
+    and NO index array — the assignment stream is counter-based, so the
+    counts for rows ``[row0, row0 + num_rows)`` are a pure function of
+    ``(seed, epoch, file_index)``. Prefix sums of the full-file counts are
+    exactly :func:`plan_partition_flat`'s ``offsets``, which is how the
+    streaming map pipeline sizes its per-reducer output regions before the
+    first record batch is decoded. Bit-identical native/NumPy paths."""
+    from ray_shuffling_data_loader_tpu import native
+    key = partition_key(seed, epoch, file_index)
+    if native.available():
+        return native.partition_counts(num_rows, num_reducers, key,
+                                       row0=row0, nthreads=nthreads)
+    assignments = native.hash_assign(num_rows, num_reducers, key, row0=row0)
+    return np.bincount(assignments,
+                       minlength=num_reducers).astype(np.int64, copy=False)
+
+
+def assign_dest_batch(num_rows: int, num_reducers: int, seed: int,
+                      epoch: int, file_index: int, row0: int,
+                      cursors: np.ndarray) -> np.ndarray:
+    """Destination slots for one record batch of the streaming map
+    pipeline: row ``row0 + i`` lands at slot ``cursors[r]`` of its reducer
+    ``r``'s region, and ``cursors`` (int64, one per reducer, seeded with
+    the region offsets) advance in place. Because batches arrive in
+    increasing global row order, each region fills in original row order —
+    the same stable order the legacy counting-sort plan guarantees, so
+    scattering batches through these slots reproduces the legacy
+    plan-then-gather layout bit for bit. int32 slots on the native path
+    (callers pre-check total rows < 2**31); the NumPy fallback computes
+    identical slot values."""
+    key = partition_key(seed, epoch, file_index)
+    from ray_shuffling_data_loader_tpu import native
+    if native.available() and int(cursors.max(initial=0)) + num_rows < 2**31:
+        return native.assign_dest(num_rows, num_reducers, key, row0, cursors)
+    assignments = native.hash_assign(num_rows, num_reducers, key, row0=row0)
+    counts = np.bincount(assignments, minlength=num_reducers)
+    order = np.argsort(assignments, kind="stable")
+    starts = np.zeros(num_reducers, dtype=np.int64)
+    np.cumsum(counts[:-1], out=starts[1:])
+    dest = np.empty(num_rows, dtype=np.int64)
+    # In stable-sorted order, reducer r's rows occupy one run; its k-th row
+    # (original order) lands at cursors[r] + k.
+    dest[order] = (np.repeat(cursors[:num_reducers], counts)
+                   + np.arange(num_rows) - np.repeat(starts, counts))
+    cursors += counts
+    return dest
+
+
 def plan_partition(num_rows: int, num_reducers: int, seed: int, epoch: int,
                    file_index: int, nthreads: int = 1) -> List[np.ndarray]:
     """Per-reducer index arrays from the fused partition plan (the

@@ -138,8 +138,12 @@ def _map_task(filename: str, global_file_index: int, num_reducers: int,
         if owner == transport.host_id:
             local[reducer_index] = chunk
         else:
+            # Fused-pipeline shards yield already-materialized tables;
+            # legacy shards yield lazy chunks gathered here.
+            payload = (chunk if isinstance(chunk, pa.Table)
+                       else chunk.materialize())
             transport.send(owner, (epoch, reducer_index, global_file_index),
-                           serialize_table(chunk.materialize()))
+                           serialize_table(payload))
     return local
 
 

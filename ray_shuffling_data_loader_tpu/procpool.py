@@ -321,24 +321,59 @@ def _worker_task_map(payload: dict) -> dict:
             _seg_table_cache.pop(table_seg, None)
             table = None
             table_seg = None
+    grouped = False
+    grouped_offsets = None
     if table is None:
+        import functools
         read_retry = rt_retry.RetryPolicy.for_component(
             "map_read", retryable=sh._transient_read_retryable)
-        try:
-            table = sh._read_map_table(filename, epoch, file_index,
-                                       read_retry)
-        except (OSError, pa.ArrowInvalid) as e:
-            if payload.get("on_bad_file") != "skip":
-                raise
-            return {"quarantined": rt_faults.QuarantinedFile(
-                filename=filename, epoch=epoch, file_index=file_index,
-                error=f"{type(e).__name__}: {e}")}
         map_transform = _load_blob(payload.get("map_transform"))
-        if map_transform is not None:
-            table = map_transform(table)
-        # Single-chunk columns => zero-copy numpy views for every reducer
-        # that maps this segment (same invariant as the thread-mode cache).
-        table = table.combine_chunks()
+        streamed = None
+        tried_fused = False
+        # Streaming fast path — epoch-scoped segments only: a cross-epoch
+        # cache grant must publish the DECODED table (the grouped layout
+        # depends on (seed, epoch), so it cannot be reused next epoch).
+        if not payload.get("cache_grant") and sh._fused_pipeline_enabled():
+            tried_fused = True
+            rt_faults.inject("map_read", epoch=epoch, task=file_index)
+            fused_fn = functools.partial(
+                sh._fused_stream_columns, filename,
+                payload["num_reducers"], seed, epoch, file_index,
+                map_transform)
+            try:
+                streamed = read_retry.call(
+                    fused_fn, describe=f"stream {filename}")
+            except (OSError, pa.ArrowInvalid) as e:
+                if payload.get("on_bad_file") != "skip":
+                    raise
+                return {"quarantined": rt_faults.QuarantinedFile(
+                    filename=filename, epoch=epoch, file_index=file_index,
+                    error=f"{type(e).__name__}: {e}")}
+        if streamed is not None:
+            out_cols, grouped_offsets, names = streamed
+            # The segment IS the grouped layout: reducer r's rows are the
+            # contiguous slice [offsets[r], offsets[r+1]) in original row
+            # order, so the reduce stage slices instead of gathering —
+            # bit-identical rows either way (same stable order).
+            table = pa.table({name: out_cols[name] for name in names})
+            grouped = True
+        else:
+            try:
+                table = sh._read_map_table(filename, epoch, file_index,
+                                           read_retry,
+                                           inject=not tried_fused)
+            except (OSError, pa.ArrowInvalid) as e:
+                if payload.get("on_bad_file") != "skip":
+                    raise
+                return {"quarantined": rt_faults.QuarantinedFile(
+                    filename=filename, epoch=epoch, file_index=file_index,
+                    error=f"{type(e).__name__}: {e}")}
+            if map_transform is not None:
+                table = map_transform(table)
+            # Single-chunk columns => zero-copy numpy views for every
+            # reducer that maps this segment (same invariant as the
+            # thread-mode cache).
+            table = table.combine_chunks()
         # The reducers gather from the SEGMENT, so the decoded table must
         # always be published — either into the cross-epoch cache slot the
         # driver granted, or into an epoch-scoped segment the driver
@@ -355,14 +390,21 @@ def _worker_task_map(payload: dict) -> dict:
     end_read = timeit.default_timer()
     rt_telemetry.record("map_read", epoch=epoch, task=file_index,
                         dur_s=end_read - start)
-    flat, offsets = ops_p.plan_partition_flat(
-        table.num_rows, payload["num_reducers"], seed, epoch, file_index,
-        nthreads=payload.get("plan_threads") or 1)
-    idx_bytes = write_index_segment(payload["idx_seg"], offsets, flat)
+    if grouped:
+        # The stream already placed every row; the index segment carries
+        # only the region offsets (empty flat array).
+        idx_bytes = write_index_segment(payload["idx_seg"], grouped_offsets,
+                                        np.empty(0, dtype=np.int64))
+    else:
+        flat, offsets = ops_p.plan_partition_flat(
+            table.num_rows, payload["num_reducers"], seed, epoch,
+            file_index, nthreads=payload.get("plan_threads") or 1)
+        idx_bytes = write_index_segment(payload["idx_seg"], offsets, flat)
     return {
         "num_rows": table.num_rows,
         "table_seg": table_seg,
         "cached": cached,
+        "grouped": grouped,
         "wrote_table_bytes": wrote_table_bytes,
         "idx_seg": payload["idx_seg"],
         "idx_bytes": idx_bytes,
@@ -393,16 +435,27 @@ def _worker_task_reduce(payload: dict) -> dict:
             rt_faults.inject("reduce_gather", epoch=epoch,
                              task=reduce_index)
             chunks = []
-            for table_seg, idx_seg, cacheable in payload["sources"]:
+            for source in payload["sources"]:
+                table_seg, idx_seg, cacheable = source[:3]
+                grouped = len(source) > 3 and bool(source[3])
                 # Epoch-scoped segments are unlinked when the epoch
                 # drains; caching them in the worker would pin the pages
                 # past that, so only cross-epoch cache segments persist.
                 table = (_cached_segment_table(table_seg) if cacheable
                          else open_table_segment(table_seg))
                 offsets, flat = read_index_segment(idx_seg)
-                idx = np.asarray(
-                    flat[offsets[reduce_index]:offsets[reduce_index + 1]])
-                chunks.append(sh.MapShard(table, [idx])[0])
+                if grouped:
+                    # Streaming-pipeline segment: rows already grouped by
+                    # reducer in original row order — a zero-copy slice
+                    # replaces the gather, bit-identically.
+                    lo = int(offsets[reduce_index])
+                    hi = int(offsets[reduce_index + 1])
+                    chunks.append(table.slice(lo, hi - lo))
+                else:
+                    idx = np.asarray(
+                        flat[offsets[reduce_index]:
+                             offsets[reduce_index + 1]])
+                    chunks.append(sh.MapShard(table, [idx])[0])
             return sh.shuffle_reduce(reduce_index, seed, epoch, chunks,
                                      None, reduce_transform,
                                      payload.get("gather_threads"))
@@ -1028,7 +1081,7 @@ def process_epoch(plan,
         return payload
 
     holder: Dict[str, Any] = {}
-    sources: List["tuple[str, str, bool]"] = []
+    sources: List["tuple[str, str, bool, bool]"] = []
     epoch_segs: List[str] = []  # epoch-scoped: unlinked at epoch drain
     transient = {"bytes": 0, "buf_id": None}
 
@@ -1087,7 +1140,8 @@ def process_epoch(plan,
                 transient["bytes"] += res.get("wrote_table_bytes", 0)
             epoch_segs.append(res["idx_seg"])
             transient["bytes"] += res.get("idx_bytes", 0)
-            sources.append((res["table_seg"], res["idx_seg"], cached))
+            sources.append((res["table_seg"], res["idx_seg"], cached,
+                            bool(res.get("grouped"))))
             if stats_collector is not None:
                 stats_collector.map_done(epoch, res["dur_s"], res["read_s"])
             rt_telemetry.observe_stage("map_read", epoch=epoch,

@@ -211,6 +211,34 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     # (seed, epoch, file); the streams differ, so flipping this knob
     # mid-checkpoint changes the shuffle order.
     "partition_plan": ("fused", str),
+    # Streaming map pipeline (RSDL_SHUFFLE_FUSED_PIPELINE): fuse
+    # decode->partition->gather at the map stage — Parquet record batches
+    # scatter straight into per-reducer output buffers, no intermediate
+    # decoded-table materialization. "auto"/True enable it wherever it
+    # preserves the caching and bit-identity contracts (cache-less reads,
+    # primitive null-free columns, elementwise transforms); False forces
+    # the legacy read-then-plan path everywhere. The partition stream is
+    # the SAME (seed, epoch, file) splitmix64 stream either way, so
+    # flipping this knob never changes the shuffle order.
+    "shuffle_fused_pipeline": ("auto", _parse_tristate),
+    # CRC backend for every checksummed path (wire frames, spill files,
+    # shm segments, watermark journals): "auto" (native kernel when the
+    # library is loaded), "native", "zlib". Output is zlib.crc32-
+    # compatible in all cases — recorded checksums survive backend flips.
+    "crc_backend": ("auto", str),
+    # Scatter-gather wire sends (RSDL_QUEUE_SENDMSG): coalesce a GET
+    # response's batch header + per-frame headers + payloads into one
+    # sendmsg() syscall instead of one sendall() per piece. Wire bytes
+    # are identical; only the syscall count changes.
+    "queue_sendmsg": (True, _parse_bool),
+    # Codec pool for RSDL_QUEUE_COMPRESSION: compression runs on this
+    # many background threads so the serving thread never stalls on
+    # codec work (0 = compress inline on the serving thread).
+    "queue_codec_threads": (1, int),
+    # Double-buffered device staging (jax_dataset.py): convert batch N+1
+    # on a staging thread while batch N's host->device transfer is in
+    # flight. Delivery order is unchanged (single staging lane, FIFO).
+    "device_double_buffer": (True, _parse_bool),
     # Epoch-plan scheduler (plan/scheduler.py). Speculative re-execution
     # of stragglers: off by default (duplicate attempts are bit-identical
     # by the lineage contract, but they absorb injected chaos faults and

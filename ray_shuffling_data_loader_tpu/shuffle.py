@@ -516,8 +516,186 @@ def plan_map_partition(num_rows: int, num_reducers: int, seed: int,
                               file_index, nthreads=_SCATTER_GATHER_THREADS)
 
 
+class FusedMapShard:
+    """Map output of the streaming decode->partition->gather pipeline.
+
+    Unlike :class:`MapShard` (source table + per-reducer index arrays,
+    gather deferred to the reduce), the fused pipeline has ALREADY placed
+    every row in its reducer's region while the Parquet record batches
+    streamed through — ``table`` holds the rows GROUPED by reducer, and
+    each reducer's chunk is a zero-copy slice ``[offsets[r], offsets[r+1])``
+    of it. The reduce body treats those slices as already-in-order sources
+    (the ``idx=None`` arm of :func:`_fused_reduce`) — rows were scattered
+    in increasing global row order, i.e. exactly the stable order the
+    legacy plan's gather produces, so both paths emit bit-identical
+    reducer outputs. The ``table`` / indexing / iteration /
+    ``materialize()`` surface matches :class:`MapShard`'s so every
+    consumer (cross-host ship, recovery, tests) works on either shape.
+    """
+
+    __slots__ = ("table", "offsets", "columns")
+
+    def __init__(self, table: pa.Table, offsets: np.ndarray,
+                 columns: Dict[str, np.ndarray]):
+        self.table = table
+        self.offsets = offsets
+        self.columns = columns  # the grouped numpy buffers backing table
+
+    def __len__(self) -> int:
+        return len(self.offsets) - 1
+
+    def __getitem__(self, reducer_index: int) -> "FusedChunk":
+        return FusedChunk(self, reducer_index)
+
+    def __iter__(self):
+        return (self[r] for r in range(len(self)))
+
+
+class FusedChunk:
+    """One reducer's zero-copy slice of a fused (grouped) map output."""
+
+    __slots__ = ("shard", "reducer_index")
+
+    def __init__(self, shard: FusedMapShard, reducer_index: int):
+        self.shard = shard
+        self.reducer_index = reducer_index
+
+    @property
+    def _bounds(self) -> "tuple[int, int]":
+        offsets = self.shard.offsets
+        return int(offsets[self.reducer_index]), \
+            int(offsets[self.reducer_index + 1])
+
+    @property
+    def num_rows(self) -> int:
+        lo, hi = self._bounds
+        return hi - lo
+
+    @property
+    def indices(self) -> np.ndarray:
+        # Rows are pre-grouped, so this chunk's rows of the shard table
+        # are simply the contiguous run — materialized lazily for the
+        # (diagnostic/test) callers that inspect the gather plan.
+        lo, hi = self._bounds
+        return np.arange(lo, hi, dtype=np.int64)
+
+    def materialize(self) -> pa.Table:
+        lo, hi = self._bounds
+        return self.shard.table.slice(lo, hi - lo)
+
+
+#: Record-batch granularity of the streaming map pipeline. Large enough
+#: that the per-batch Python overhead (dest assignment, column loop)
+#: amortizes; small enough that decode->scatter stays cache-resident and
+#: peak memory holds ~one batch plus the grouped output.
+_FUSED_STREAM_BATCH_ROWS = 1 << 16
+
+
+def _fused_pipeline_enabled() -> bool:
+    return rt_policy.resolve("shuffle", "shuffle_fused_pipeline") is not False
+
+
+def _fused_stream_columns(filename: str, num_reducers: int, seed: int,
+                          epoch: int, file_index: int,
+                          map_transform: Optional[MapTransform]):
+    """Stream a Parquet file's record batches straight into per-reducer
+    grouped column buffers: fused decode->partition->gather, no
+    intermediate decoded-table materialization.
+
+    Returns ``(out_cols, offsets, names)`` — flat per-column arrays
+    grouped by reducer plus the region offsets — or ``None`` whenever the
+    input falls outside the fast path's contract (non-primitive or
+    nullable columns, a transform that is not row-elementwise, >= 2**31
+    rows, mid-stream schema drift): the caller falls back to the legacy
+    read-then-plan path, whose output is bit-identical.
+
+    The partition stream is the same ``(seed, epoch, file_index)``
+    splitmix64 stream as :func:`plan_map_partition`'s fused plan —
+    per-reducer counts come from the hash alone (no data), and each
+    batch's rows scatter to ``assign_dest_batch`` slots that reproduce the
+    legacy counting sort's stable layout.
+    """
+    import pyarrow.parquet as pq
+    from ray_shuffling_data_loader_tpu import native
+    if map_transform is not None and not getattr(
+            map_transform, "row_elementwise", False):
+        return None
+    pf = pq.ParquetFile(filename)
+    try:
+        num_rows = pf.metadata.num_rows
+        if num_rows <= 0 or num_rows >= 2**31:
+            return None
+        counts = ops.partition_counts(num_rows, num_reducers, seed, epoch,
+                                      file_index,
+                                      nthreads=_SCATTER_GATHER_THREADS)
+        offsets = np.zeros(num_reducers + 1, dtype=np.int64)
+        np.cumsum(counts, out=offsets[1:])
+        cursors = offsets[:-1].copy()
+        use_native = native.available()
+        out_cols: Optional[Dict[str, np.ndarray]] = None
+        names: Optional[List[str]] = None
+        row0 = 0
+        for batch in pf.iter_batches(batch_size=_FUSED_STREAM_BATCH_ROWS):
+            tbl = pa.Table.from_batches([batch])
+            if map_transform is not None:
+                tbl = map_transform(tbl)
+                if tbl.num_rows != batch.num_rows:
+                    return None
+            cols = _table_numpy_columns(tbl)
+            if cols is None:
+                return None
+            if out_cols is None:
+                names = list(cols)
+                out_cols = {name: np.empty(num_rows, dtype=cols[name].dtype)
+                            for name in names}
+            elif (list(cols) != names
+                  or any(cols[n].dtype != out_cols[n].dtype for n in names)):
+                return None
+            n = tbl.num_rows
+            dest = ops.assign_dest_batch(n, num_reducers, seed, epoch,
+                                         file_index, row0, cursors)
+            for name in names:
+                src = cols[name]
+                out = out_cols[name]
+                if (use_native and dest.dtype == np.int32
+                        and src.flags.c_contiguous
+                        and src.dtype.itemsize in (1, 2, 4, 8)):
+                    native.scatter_gather(src, None, dest, out,
+                                          nthreads=_SCATTER_GATHER_THREADS)
+                else:
+                    out[dest] = src
+            row0 += n
+        if out_cols is None or row0 != num_rows:
+            return None  # torn metadata: let the legacy reader diagnose it
+        return out_cols, offsets, names
+    finally:
+        try:
+            pf.close()
+        except AttributeError:  # older pyarrow: reader closes with GC
+            pass
+
+
+def _fused_stream_map(filename: str, num_reducers: int, seed: int,
+                      epoch: int, file_index: int,
+                      map_transform: Optional[MapTransform]
+                      ) -> Optional[FusedMapShard]:
+    """:func:`_fused_stream_columns` packaged as per-reducer zero-copy
+    table chunks (the thread backend's shard shape); ``None`` when the
+    file is outside the fast path's contract."""
+    streamed = _fused_stream_columns(filename, num_reducers, seed, epoch,
+                                     file_index, map_transform)
+    if streamed is None:
+        return None
+    out_cols, offsets, names = streamed
+    from ray_shuffling_data_loader_tpu import native
+    table = pa.table({name: out_cols[name] for name in names})
+    native.account_table(table)
+    return FusedMapShard(table, offsets, out_cols)
+
+
 def _read_map_table(filename: str, epoch: int, file_index: int,
-                    read_retry: Optional[rt_retry.RetryPolicy]) -> pa.Table:
+                    read_retry: Optional[rt_retry.RetryPolicy],
+                    inject: bool = True) -> pa.Table:
     """The map task's Parquet read, as one named fault site plus an
     in-place retry for transient IO errors (an NFS/GCS blip heals on
     retry; a corrupt file does not, so ``ArrowInvalid`` is not retried
@@ -526,8 +704,12 @@ def _read_map_table(filename: str, epoch: int, file_index: int,
     ``faults.inject`` sits OUTSIDE the retried read on purpose: an
     injected fault simulates a *lost task*, and must surface to the
     lineage-recovery machinery under test rather than be absorbed here.
+    ``inject=False`` skips the fault site — used when the caller already
+    fired it for this task (the streaming pipeline's ineligible-file
+    fallback) so one map task never consumes two injections.
     """
-    rt_faults.inject("map_read", epoch=epoch, task=file_index)
+    if inject:
+        rt_faults.inject("map_read", epoch=epoch, task=file_index)
     if read_retry is None:
         return fileio.read_parquet(filename)
     return read_retry.call(fileio.read_parquet, filename,
@@ -562,6 +744,51 @@ def shuffle_map(filename: str,
         stats_collector.map_start(epoch)
     start = timeit.default_timer()
     with trace_span(f"shuffle_map e{epoch} f{file_index}"):
+        # Streaming fast path: cache-less reads only — the decoded table is
+        # never materialized, so there is nothing to publish into a
+        # cross-epoch cache (cached runs keep the legacy read: their
+        # steady-state epochs pay no decode at all, and the reduce's single
+        # fused gather is already one pass).
+        if file_cache is None and _fused_pipeline_enabled():
+            rt_faults.inject("map_read", epoch=epoch, task=file_index)
+            fused_fn = functools.partial(
+                _fused_stream_map, filename, num_reducers, seed, epoch,
+                file_index, map_transform)
+            try:
+                shard = (fused_fn() if read_retry is None
+                         else read_retry.call(fused_fn,
+                                              describe=f"stream {filename}"))
+            except (OSError, pa.ArrowInvalid) as e:
+                if on_bad_file != "skip":
+                    raise
+                report = rt_faults.QuarantinedFile(
+                    filename=filename, epoch=epoch, file_index=file_index,
+                    error=f"{type(e).__name__}: {e}")
+                stats_mod.fault_stats().record_quarantine(report)
+                logger.error(
+                    "quarantined unreadable input file %s (epoch %d, "
+                    "file %d): %s; shuffling the remaining files "
+                    "(on_bad_file='skip')", filename, epoch, file_index, e)
+                if stats_collector is not None:
+                    stats_collector.map_done(
+                        epoch, timeit.default_timer() - start,
+                        timeit.default_timer() - start)
+                return report
+            if shard is not None:
+                end_read = timeit.default_timer()
+                rt_telemetry.record("map_read", epoch=epoch,
+                                    task=file_index, dur_s=end_read - start)
+                if stats_collector is not None:
+                    stats_collector.map_done(
+                        epoch, timeit.default_timer() - start,
+                        end_read - start)
+                return shard
+            # Ineligible for streaming: legacy read below (the map_read
+            # fault site already fired once for this task, so skip the
+            # legacy reader's injection).
+            inject_fault = False
+        else:
+            inject_fault = True
         table = file_cache.get(filename) if file_cache is not None else None
         if table is None:
             # Local path or remote URI (gs://, s3://, ... — the reference
@@ -569,7 +796,7 @@ def shuffle_map(filename: str,
             # above keys on the full URI string either way.
             try:
                 table = _read_map_table(filename, epoch, file_index,
-                                        read_retry)
+                                        read_retry, inject=inject_fault)
             except (OSError, pa.ArrowInvalid) as e:
                 if on_bad_file != "skip":
                     raise
@@ -765,6 +992,15 @@ def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
                 break
             chunk_schema = chunk.shard.table.schema
             sources.append((cols, chunk.indices, chunk.num_rows))
+        elif isinstance(chunk, FusedChunk):
+            # Pre-grouped rows: this reducer's run is a contiguous slice
+            # of the shard's numpy buffers, already in the stable order
+            # the legacy gather would produce — the idx=None arm.
+            lo, hi = chunk._bounds
+            cols = {name: arr[lo:hi]
+                    for name, arr in chunk.shard.columns.items()}
+            chunk_schema = chunk.shard.table.schema
+            sources.append((cols, None, hi - lo))
         else:
             cols = _table_numpy_columns(chunk)
             if cols is None:
@@ -782,7 +1018,8 @@ def _shuffle_reduce_body(reduce_index, seed, epoch, chunks,
     if shuffled is None and chunks:
         # Fallback: nested / nullable / mixed-schema columns.
         tables = [
-            c.materialize() if isinstance(c, LazyChunk) else c for c in chunks
+            c.materialize() if isinstance(c, (LazyChunk, FusedChunk)) else c
+            for c in chunks
         ]
         # permissive promotion: a map-side transform (or a partially
         # promoted cross-host stream) may hand this reducer chunks whose

@@ -508,6 +508,28 @@ BYTES_CONCAT_OK = """
         return bytes(buf) + b"".join(chunks)
 """
 
+SENDALL_LOOP_BAD = """
+    def send_frames(conn, frames):
+        for frame in frames:
+            conn.sendall(frame.header)
+            conn.sendall(frame.payload)
+"""
+
+SENDALL_LOOP_OK = """
+    def send_frames(conn, frames):
+        vecs = []
+        for frame in frames:
+            vecs.append(frame.header)
+            vecs.append(frame.payload)
+        _sendmsg_all(conn, vecs)
+
+    def heartbeat(sock, stop):
+        # while-loop protocol exchange: one message per beat, nothing
+        # to gather — deliberately not flagged
+        while not stop.is_set():
+            sock.sendall(b"ping")
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -532,6 +554,7 @@ CASES = [
      {"path": "pkg/shuffle.py"}),
     ("bytes-concat-in-loop", BYTES_CONCAT_AUG_BAD, BYTES_CONCAT_OK, {}),
     ("bytes-concat-in-loop", BYTES_CONCAT_REBIND_BAD, BYTES_CONCAT_OK, {}),
+    ("sendall-in-loop", SENDALL_LOOP_BAD, SENDALL_LOOP_OK, {}),
     ("unregistered-metric", UNREGISTERED_METRIC_BAD, UNREGISTERED_METRIC_OK,
      {"path": "ray_shuffling_data_loader_tpu/multiqueue.py"}),
     ("metric-label-cardinality", METRIC_LABEL_CARD_BAD,

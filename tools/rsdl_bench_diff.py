@@ -110,6 +110,21 @@ def compare_records(base: Dict[str, Any], cur: Dict[str, Any],
         threshold = overrides.get(key, rule.get("pct", rule.get("limit")))
         cur_v = _num(cur, key)
         if cur_v is None:
+            # A metric the baseline measured but the current record lost
+            # is a gate failure, not a skip — BENCH_r05 went out with
+            # train_mfu_pct silently null and nothing flagged it.
+            # Ceilings apply to the current record alone, so absence
+            # there stays a non-finding.
+            if mode != "ceiling":
+                base_v = _num(base, key)
+                if base_v is not None:
+                    findings.append({
+                        "key": key, "mode": mode, "base": base_v,
+                        "cur": None, "delta_pct": None,
+                        "threshold_pct": threshold, "ok": False,
+                        "reason": f"metric disappeared (base {base_v:g}, "
+                                  "current record has no numeric value)",
+                    })
             continue
         if mode == "ceiling":
             ok = cur_v <= threshold

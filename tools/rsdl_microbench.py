@@ -17,6 +17,15 @@ Stages:
 - ``fused_gather``     — shuffle._fused_reduce over one source
 - ``ipc_handoff``      — Arrow IPC segment write + zero-copy mmap open
                          (the process backend's shm handoff)
+- ``crc``              — frame-checksum throughput: native.crc32 (HW or
+                         slice-by-8) timed as the stage, zlib.crc32 as
+                         the comparison extra, equality spot-checked
+                         across buffer sizes and alignments first
+- ``wire_syscall``     — GET-response wire write: one sendmsg
+                         scatter-gather batch timed as the stage, the
+                         legacy per-buffer sendall loop as the
+                         comparison extra (socketpair, drained by a
+                         reader thread)
 - ``telemetry_record`` — per-event flight-recorder cost (enabled path)
 
 Output: a JSON record on stdout whose ``stages`` block mirrors the bench
@@ -68,6 +77,99 @@ def _stage_record(samples, rows):
         "rows_per_s": round(rows / p50, 1) if p50 > 0 else None,
         "ns_per_row": round(1e9 * p50 / rows, 3) if rows else None,
     }
+
+
+def _crc_stage(rows, repeats):
+    """native.crc32 over an 8-bytes-per-row buffer (the stage; what the
+    wire/spill/journal paths actually call) with zlib.crc32 as the
+    comparison extra. ``rows`` maps to bytes/8 so ns_per_row stays
+    comparable with the other stages' per-row accounting."""
+    import zlib
+
+    import numpy as np
+
+    from ray_shuffling_data_loader_tpu import native
+
+    nbytes = rows * 8
+    blob = np.random.default_rng(1).integers(
+        0, 256, size=nbytes, dtype=np.uint8).tobytes()
+    # Equality first, speed second: odd sizes and misaligned views are
+    # where a word-at-a-time CRC kernel goes wrong.
+    view = memoryview(blob)
+    for size in (0, 1, 7, 8, 63, 64, 4095, min(1 << 16, nbytes)):
+        for offset in (0, 1, 3, 7):
+            piece = view[offset:offset + size]
+            assert native.crc32(piece) == (zlib.crc32(piece) & 0xFFFFFFFF)
+
+    native_samples = _time_stage(lambda: native.crc32(blob), repeats)
+    zlib_samples = _time_stage(lambda: zlib.crc32(blob), repeats)
+    record = _stage_record(native_samples, rows)
+    zlib_p50 = statistics.median(zlib_samples)
+    record["backend"] = native.crc_backend()
+    record["zlib_p50_ms"] = round(zlib_p50 * 1e3, 4)
+    native_p50 = statistics.median(native_samples)
+    record["speedup_vs_zlib_x"] = (round(zlib_p50 / native_p50, 2)
+                                   if native_p50 > 0 else None)
+    return record
+
+
+def _wire_syscall_stage(rows, repeats):
+    """One GET-response worth of frames over a socketpair: the sendmsg
+    scatter-gather batch (the stage) vs the legacy per-buffer sendall
+    loop (the comparison extra). A reader thread drains so the send side
+    measures syscall + copy cost, not backpressure."""
+    import socket
+    import threading
+
+    import ray_shuffling_data_loader_tpu.multiqueue_service as mq
+
+    # ~GET-batch shape: many small header-sized buffers interleaved with
+    # payload chunks, sized so total bytes track ``rows`` (8 B/row).
+    total = rows * 8
+    chunk = 16 << 10
+    nframes = max(1, total // (chunk + 64))
+    payload = b"\xa5" * chunk
+    header = b"\x5a" * 64
+    buffers = [header, payload] * nframes
+
+    sender, receiver = socket.socketpair()
+    done = threading.Event()
+
+    def drain():
+        try:
+            while receiver.recv(1 << 20):
+                pass
+        except OSError:
+            pass
+        done.set()
+
+    reader = threading.Thread(target=drain, daemon=True)
+    reader.start()
+    try:
+        sendmsg_samples = _time_stage(
+            lambda: mq._sendmsg_all(sender, buffers), repeats)
+
+        def sendall_loop():
+            for buf in buffers:
+                # The measured LEGACY leg — the syscall-per-buffer shape
+                # this stage exists to compare against.
+                # rsdl-lint: disable=sendall-in-loop
+                sender.sendall(buf)
+
+        sendall_samples = _time_stage(sendall_loop, repeats)
+    finally:
+        sender.close()
+        done.wait(timeout=5)
+        receiver.close()
+
+    record = _stage_record(sendmsg_samples, rows)
+    sendall_p50 = statistics.median(sendall_samples)
+    sendmsg_p50 = statistics.median(sendmsg_samples)
+    record["buffers_per_batch"] = len(buffers)
+    record["sendall_p50_ms"] = round(sendall_p50 * 1e3, 4)
+    record["speedup_vs_sendall_x"] = (round(sendall_p50 / sendmsg_p50, 2)
+                                      if sendmsg_p50 > 0 else None)
+    return record
 
 
 def run(rows: int, repeats: int, num_reducers: int) -> dict:
@@ -136,6 +238,9 @@ def run(rows: int, repeats: int, num_reducers: int) -> dict:
                 os.rmdir(os.path.dirname(seg_path))
             except OSError:
                 pass
+
+    stages["crc"] = _crc_stage(rows, repeats)
+    stages["wire_syscall"] = _wire_syscall_stage(rows, repeats)
 
     per_event_s = rt_tel.measure_record_overhead()
     stages["telemetry_record"] = {
