@@ -114,6 +114,16 @@ class Config:
     lineage_plan_exempt_globs: Tuple[str, ...] = (
         "*ray_shuffling_data_loader_tpu/plan/*",
         "*ray_shuffling_data_loader_tpu/ops/partition.py")
+    # fnmatch patterns of library files where dataset bytes must flow
+    # through storage/ (the tiered cache + chaos-site boundary), never
+    # raw pyarrow.parquet reads.
+    dataset_read_globs: Tuple[str, ...] = (
+        "ray_shuffling_data_loader_tpu/*", "bench.py")
+    # Files exempt from raw-dataset-read: the storage plane itself and
+    # the low-level fileio primitive it is built on.
+    dataset_read_exempt_globs: Tuple[str, ...] = (
+        "*ray_shuffling_data_loader_tpu/storage/*",
+        "*ray_shuffling_data_loader_tpu/utils/fileio.py")
 
     @classmethod
     def from_dict(cls, data: dict) -> "Config":
@@ -161,7 +171,7 @@ def all_rules() -> Dict[str, Rule]:
     from ray_shuffling_data_loader_tpu.analysis import (  # noqa: F401
         rules_arrow, rules_executor, rules_hygiene, rules_jax, rules_lock,
         rules_metrics, rules_perf, rules_plan, rules_runtime,
-        rules_telemetry)
+        rules_storage, rules_telemetry)
     return dict(_REGISTRY)
 
 

@@ -34,6 +34,19 @@ satisfying the ``executor.Executor`` contract:
   placement is strictly static (the A/B baseline the equivalence tests
   pin — outputs are identical either way, only idle time differs).
 
+- **Idle-lane prefetch** (``prefetcher=``, storage/prefetch.py): a lane
+  with no real work, nothing to steal, and no speculation candidate
+  pulls a cache-warming task from the prefetcher instead of idling —
+  the lowest rung of the priority ladder (ready nodes > steals >
+  speculation > prefetch). A prefetch does NOT mark its lane busy: the
+  lane stays claimable, and the moment real work lands on it the
+  prefetch is canceled (best effort — a transfer already in flight
+  finishes and still warms the cache). Warms run on dedicated daemon
+  threads, never on pool workers, so an in-flight remote fetch cannot
+  occupy a worker slot a real task would queue behind. Prefetches
+  still in flight when the plan resolves are left to complete: they
+  are warming the files the NEXT epoch's plan reads.
+
 The engine runs on one named driver thread per plan (no polling when
 speculation is off: dispatch is woken by completion events). Stage
 barrier hooks (``barriers={stage: fn}``) run on the driver thread after
@@ -145,13 +158,18 @@ class PlanScheduler:
                  policy: Optional[SchedulerPolicy] = None,
                  speculative_stages: Sequence[str] = ("map", "reduce"),
                  lanes: Optional[int] = None,
-                 name: Optional[str] = None):
+                 name: Optional[str] = None,
+                 prefetcher=None):
         plan.validate()
         self.plan = plan
         self.pool = pool
         self.policy = policy if policy is not None else SchedulerPolicy()
         self._dispatchers = dict(dispatchers)
         self._barriers = dict(barriers or {})
+        #: storage.prefetch.PrefetchManager (duck-typed: ``next()`` ->
+        #: task with ``run``/``cancel``) feeding idle lanes, or None.
+        self._prefetcher = prefetcher
+        self._lane_prefetch: Dict[int, object] = {}
         self._speculative_stages = frozenset(speculative_stages)
         self._lanes = max(1, lanes if lanes is not None
                           else getattr(pool, "num_workers", 1))
@@ -264,7 +282,42 @@ class PlanScheduler:
                 state = self._take_work(lane)
                 if state is None:
                     break
+                # Real work outranks a warming fetch: reclaim the lane.
+                self._cancel_prefetch(lane)
                 self._dispatch(state, attempt=0, lane=lane)
+        if self._prefetcher is not None:
+            self._fill_prefetch()
+
+    def _cancel_prefetch(self, lane: int) -> None:
+        task = self._lane_prefetch.pop(lane, None)
+        if task is not None:
+            task.cancel()
+
+    def _fill_prefetch(self) -> None:
+        """Bottom of the priority ladder: lanes with no real work, and
+        nothing stealable, pull cache-warming tasks. The lane is NOT
+        marked busy — and the warm runs on its own daemon thread, NOT
+        the executor pool: a submitted pool task would occupy a real
+        worker slot for the whole remote fetch, so the next epoch's map
+        (or this epoch's reduce) would queue behind a cache warm —
+        exactly the priority inversion the ladder forbids. A warm is
+        mostly remote-latency sleep; a thread per in-flight warm
+        (bounded by the lane count) costs nothing the pool would not."""
+        for lane in range(self._lanes):
+            if (self._lane_busy[lane] or lane in self._lane_prefetch
+                    or self._lane_queues[lane]):
+                continue
+            task = self._prefetcher.next()
+            if task is None:
+                return
+            def _warm(task=task, lane=lane):
+                try:
+                    task.run()
+                finally:
+                    self._events.put(("__prefetch__", lane))
+            self._lane_prefetch[lane] = task
+            threading.Thread(target=_warm, daemon=True,
+                             name=f"{self._name}-prefetch-l{lane}").start()
 
     def _take_work(self, lane: int) -> Optional[_NodeState]:
         own = self._lane_queues[lane]
@@ -314,6 +367,11 @@ class PlanScheduler:
             lambda _f: self._events.put((nid, aid)))
 
     def _handle_done(self, nid: str, attempt: int) -> None:
+        if nid == "__prefetch__":
+            # A warming task finished (or was canceled): free its lane's
+            # prefetch slot so _fill_lanes can issue the next one.
+            self._lane_prefetch.pop(attempt, None)
+            return
         state = self._states.get(nid)
         if state is None:
             return
@@ -411,7 +469,8 @@ class PlanScheduler:
             if elapsed <= threshold:
                 continue
             state.backup_launched = True
-            idle.pop()
+            # Speculation outranks prefetch for the lane's capacity.
+            self._cancel_prefetch(idle.pop())
             logger.warning(
                 "%s: task %s running %.3fs (> %.3fs threshold); "
                 "launching speculative backup", self._name, node.id,

@@ -1043,8 +1043,16 @@ def process_epoch(plan,
     re-run once more from lineage inside that barrier; only exhausted
     recovery propagates (thread-mode ``EpochLineage`` semantics).
     Speculative backup attempts (``RSDL_PLAN_SPECULATION``) re-run the
-    same lineage payload on another worker; segment writes are atomic
-    and bit-identical, so first-completion-wins is safe.
+    same lineage payload on another worker under ATTEMPT-SCOPED segment
+    paths (``…a1.idx``): a cache-granted primary writes a flat
+    ``(offsets, flat)`` index while an ungranted backup streams the
+    grouped layout, so the two attempts' bytes are NOT identical and a
+    shared path would let the loser's atomic rewrite silently mismatch
+    the winner's ``grouped`` flag in ``sources`` (an empty flat array
+    read as a gather index drops the whole file's rows). With per-attempt
+    paths first-completion-wins is safe: the winner's ``res`` carries its
+    own paths into ``sources``, and the loser's files are reaped at epoch
+    drain (or by pool teardown if the loser finishes after the drain).
     """
     import importlib
     sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
@@ -1059,7 +1067,11 @@ def process_epoch(plan,
                                             pool.num_workers)
 
     def _map_payload(file_index: int, filename: str,
-                     allow_cache_write: bool) -> dict:
+                     allow_cache_write: bool, attempt: int = 0) -> dict:
+        # Attempt-scoped paths: a backup attempt must never rewrite the
+        # primary's segments (the two can legally differ in layout — see
+        # the function docstring).
+        suffix = f".a{attempt}" if attempt else ""
         payload = {
             "filename": filename,
             "num_reducers": num_reducers,
@@ -1069,7 +1081,8 @@ def process_epoch(plan,
             "on_bad_file": on_bad_file,
             "map_transform": map_transform_blob,
             "plan_threads": plan_threads,
-            "idx_seg": pool.segment_path(f"e{epoch}_f{file_index}.idx"),
+            "idx_seg": pool.segment_path(
+                f"e{epoch}_f{file_index}{suffix}.idx"),
             "table_seg": pool.cached_table_seg(filename),
         }
         if payload["table_seg"] is None:
@@ -1077,7 +1090,7 @@ def process_epoch(plan,
                      if allow_cache_write else None)
             payload["cache_grant"] = grant is not None
             payload["write_table_seg"] = grant or pool.segment_path(
-                f"e{epoch}_f{file_index}_table.arrow")
+                f"e{epoch}_f{file_index}_table{suffix}.arrow")
         return payload
 
     holder: Dict[str, Any] = {}
@@ -1088,9 +1101,16 @@ def process_epoch(plan,
     def _dispatch_map(node, attempt: int) -> ProcTaskRef:
         file_index = node.key.task
         payload = _map_payload(file_index, node.meta["file"],
-                               allow_cache_write=attempt == 0)
+                               allow_cache_write=attempt == 0,
+                               attempt=attempt)
         if attempt:
             payload["attempt"] = attempt
+            # Pre-register the backup's epoch-scoped segments so the
+            # loser's files are reaped at epoch drain; if it wins,
+            # _collect_maps re-appends the same paths (unlink is quiet).
+            epoch_segs.append(payload["idx_seg"])
+            if payload.get("write_table_seg"):
+                epoch_segs.append(payload["write_table_seg"])
         elif stats_collector is not None:
             stats_collector.map_start(epoch)
         return pool.submit_kind("map", payload, affinity=file_index)
@@ -1153,6 +1173,7 @@ def process_epoch(plan,
 
     def _dispatch_reduce(node, attempt: int) -> ProcTaskRef:
         reduce_index = node.key.task
+        suffix = f".a{attempt}" if attempt else ""
         payload = {
             "reduce_index": reduce_index,
             "seed": seed,
@@ -1161,10 +1182,15 @@ def process_epoch(plan,
             "gather_threads": gather_threads,
             "reduce_transform": reduce_transform_blob,
             "out_seg": pool.segment_path(
-                f"e{epoch}_r{reduce_index}.arrow"),
+                f"e{epoch}_r{reduce_index}{suffix}.arrow"),
         }
         if attempt:
             payload["attempt"] = attempt
+            # Reap the loser's output at epoch drain; a winner's file is
+            # unlink-while-mmapped (safe) and its finalize unlink is
+            # quiet. A loser that finishes after the drain is left for
+            # pool teardown.
+            epoch_segs.append(payload["out_seg"])
         elif stats_collector is not None:
             stats_collector.reduce_start(epoch)
         return pool.submit_kind("reduce", payload)

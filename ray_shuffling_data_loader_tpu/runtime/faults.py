@@ -31,6 +31,12 @@ site                 where it fires
 ``ack_lost``         ``RemoteQueue`` request sending — one GET's ack
                      watermark suppressed; harmless by design (acks are
                      cumulative)
+``storage_read``     the ``storage`` source fetch (``storage.read_table``
+                     / ``storage.open_parquet``) — the remote-object-GET
+                     failure shape, surfaced before the in-place IO retry
+``storage_stall``    same boundary, but with ``:delayN`` — a slow remote
+                     first byte (latency, not loss); without a delay it
+                     behaves like ``storage_read``
 ===================  ======================================================
 
 A chaos spec (``RSDL_CHAOS_SPEC`` env var, or :func:`install`) is a
@@ -86,6 +92,8 @@ SITES = frozenset({
     # Process-level sites (PR 5): the cross-process queue topology.
     "queue_server_crash", "conn_reset_midframe", "frame_corrupt",
     "ack_lost",
+    # Storage plane (storage/): the remote-object fetch boundary.
+    "storage_read", "storage_stall",
 })
 
 _SPEC_ENVS = ("RSDL_CHAOS_SPEC", "RSDL_FAULTS_SPEC")

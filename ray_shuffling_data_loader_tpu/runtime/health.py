@@ -40,6 +40,12 @@ Detectors (thresholds under their policy keys; ``RSDL_SLO_<KEY>`` env):
                           how long it has sat unchanged (a pipeline that
                           stops delivering freezes its gauge; the age
                           keeps growing) — exceeded ``slo_freshness_s``
+``cache_thrash``          the tiered storage cache (storage/cache.py) is
+                          evicting faster than
+                          ``slo_cache_evictions_per_min`` while its hit
+                          share over the same window sits below
+                          ``slo_cache_hit_pct`` — churning entries it
+                          never serves (working set exceeds the budget)
 ========================  =================================================
 
 On fire (or on ``SIGUSR2`` — :func:`install_incident_signal`, the
@@ -416,11 +422,56 @@ class FreshnessStallDetector(Detector):
         return None
 
 
+class CacheThrashDetector(Detector):
+    """Tiered storage cache evicting entries it never gets to serve.
+
+    Thrash is a *joint* condition: a high eviction rate alone is fine
+    while the hit share stays healthy (steady-state LRU turnover), and
+    a low hit share alone is the expected cold-start shape. Only the
+    combination — evictions above ``slo_cache_evictions_per_min`` while
+    hits/(hits+misses) over the same window sits below
+    ``slo_cache_hit_pct`` — means the working set has outgrown the
+    cache budget and every insert is displacing something still live."""
+
+    name = "cache_thrash"
+
+    def __init__(self, component: str = "health", **overrides: Any):
+        super().__init__(component, **overrides)
+        self.evictions_per_min = self._resolve("slo_cache_evictions_per_min")
+        self.hit_pct = self._resolve("slo_cache_hit_pct")
+        self.window_ticks = self._resolve("slo_droop_window_ticks")
+
+    def evaluate(self, ring: rt_history.HistoryRing) -> Optional[Breach]:
+        window = max(1, int(self.window_ticks))
+        evict_rates = ring.rate("rsdl_storage_evictions_total",
+                                window_ticks=window)
+        if not evict_rates:
+            return None
+        evict_per_min = evict_rates[-1][1] * 60.0
+        if evict_per_min <= self.evictions_per_min:
+            return None
+        hits = ring.series("rsdl_storage_hits_total")
+        misses = ring.series("rsdl_storage_misses_total")
+        if len(hits) <= window or len(misses) <= window:
+            return None
+        dh = max(0.0, hits[-1][1] - hits[-1 - window][1])
+        dm = max(0.0, misses[-1][1] - misses[-1 - window][1])
+        if dh + dm <= 0:
+            return None
+        hit_pct = 100.0 * dh / (dh + dm)
+        if hit_pct < self.hit_pct:
+            return self._breach(
+                evict_per_min, self.evictions_per_min,
+                f"cache evicting {evict_per_min:.1f}/min at "
+                f"{hit_pct:.1f}% hit rate (floor {self.hit_pct:.0f}%)")
+        return None
+
+
 _DETECTOR_TYPES: Dict[str, type] = {
     cls.name: cls for cls in (
         ThroughputDroopDetector, StallBreachDetector, LedgerCreepDetector,
         QueueSaturationDetector, LeaseChurnDetector, StragglerDriftDetector,
-        DeliveryLatencyDetector, FreshnessStallDetector)
+        DeliveryLatencyDetector, FreshnessStallDetector, CacheThrashDetector)
 }
 
 

@@ -79,6 +79,17 @@ METRIC_NAMES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     # -- spill tier (spill.py) --
     "rsdl_spills_total": ("counter", ()),
     "rsdl_spilled_bytes_total": ("counter", ()),
+    # -- storage plane (storage/: tiered cache + plan-driven prefetch;
+    #    the tier label is the fixed {hot, disk, remote} vocabulary) --
+    "rsdl_storage_hits_total": ("counter", ("tier",)),
+    "rsdl_storage_misses_total": ("counter", ("tier",)),
+    "rsdl_storage_evictions_total": ("counter", ("tier",)),
+    "rsdl_storage_corrupt_total": ("counter", ("tier",)),
+    "rsdl_storage_tier_bytes": ("gauge", ("tier",)),
+    "rsdl_storage_remote_bytes_read_total": ("counter", ()),
+    "rsdl_storage_prefetch_issued_total": ("counter", ()),
+    "rsdl_storage_prefetch_hits_total": ("counter", ()),
+    "rsdl_storage_prefetch_canceled_total": ("counter", ()),
     # -- ops plane: history / health / incidents (runtime/{history,health}) --
     "rsdl_process_rss_bytes": ("gauge", ()),
     "rsdl_ledger_bytes_in_use": ("gauge", ()),

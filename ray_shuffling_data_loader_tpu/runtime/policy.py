@@ -262,6 +262,33 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     # run) or "skip" (quarantine the file into a structured
     # QuarantinedFile report and shuffle the remaining files).
     "on_bad_file": ("raise", str),
+    # Storage plane (storage/): which StorageSource dataset reads resolve
+    # to when nothing is installed programmatically — "local" (direct
+    # filesystem/fsspec reads, the historical behavior), "sim" (the
+    # hermetic SimulatedObjectStore over local files, for tests and the
+    # 1-CPU bench's remote leg).
+    "storage_backend": ("local", str),
+    # Plan-driven cache warming: when the active file cache exposes a
+    # prefetcher, the plan scheduler issues prefetch tasks on idle lanes
+    # (below steal/speculation priority, canceled when real work lands).
+    "storage_prefetch": (True, _parse_bool),
+    # SimulatedObjectStore shape (RSDL_STORAGE_SIM_*): first-byte latency
+    # (ms), sustained bandwidth (MB/s), multiplicative jitter (+/- pct,
+    # seeded), transient error rate (fraction of fetches raising OSError
+    # — absorbed by the storage RetryPolicy), and the draw seed. All
+    # draws are a pure function of (seed, path, attempt-count), so a
+    # fixed seed reproduces byte-identical timing/error sequences.
+    "storage_sim_first_byte_ms": (2.0, float),
+    "storage_sim_mb_per_s": (512.0, float),
+    "storage_sim_jitter_pct": (10.0, float),
+    "storage_sim_error_rate": (0.0, float),
+    "storage_sim_seed": (0, int),
+    # Cache-thrash detector (runtime/health.py): fires when the tiered
+    # cache's eviction rate exceeds this many evictions/min while its
+    # hit rate sits below slo_cache_hit_pct — the signature of a disk
+    # tier smaller than the working set re-fetching every epoch.
+    "slo_cache_evictions_per_min": (120.0, float),
+    "slo_cache_hit_pct": (10.0, float),
 }
 
 _lock = threading.Lock()

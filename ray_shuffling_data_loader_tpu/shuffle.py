@@ -38,16 +38,23 @@ from typing import (Any, Callable, Dict, List, Optional, Sequence, Tuple,
 
 import numpy as np
 import pyarrow as pa
-import pyarrow.parquet as pq
 
 from ray_shuffling_data_loader_tpu import executor as ex
 from ray_shuffling_data_loader_tpu import stats as stats_mod
+from ray_shuffling_data_loader_tpu import storage as rt_storage
 from ray_shuffling_data_loader_tpu.ops import partition as ops
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
 from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
 from ray_shuffling_data_loader_tpu.runtime import retry as rt_retry
 from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
-from ray_shuffling_data_loader_tpu.utils import fileio
+# Retired into the storage package (PR 14) but re-exported here: the
+# disk tier predates storage/ and callers construct it by this name.
+from ray_shuffling_data_loader_tpu.storage.cache import (  # noqa: F401
+    DiskTableCache, DiskTier, TieredStore)
+# Not read directly anymore (dataset bytes flow through rt_storage), but
+# kept as a re-export: tests and downstream callers reach fileio via the
+# shuffle namespace.
+from ray_shuffling_data_loader_tpu.utils import fileio  # noqa: F401
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 from ray_shuffling_data_loader_tpu.utils.tracing import trace_span
 
@@ -235,167 +242,11 @@ def default_file_cache() -> Optional[FileTableCache]:
     return None
 
 
-class DiskTableCache:
-    """Decoded-table cache on local disk: Arrow IPC files, memory-mapped
-    back on hit.
-
-    The cold regime's dominant per-epoch cost is Parquet decompression +
-    decode, which the reference re-pays every epoch (reference:
-    shuffle.py:208) and the RAM cache can only skip while the decoded
-    corpus fits in memory. This tier removes the constraint: the FIRST
-    decode of a file writes the decoded table as an UNCOMPRESSED Arrow IPC
-    file to local scratch; every later epoch memory-maps it — no
-    decompression, no parse, zero-copy columns whose pages fault in lazily
-    and remain reclaimable page cache, so RSS stays bounded no matter how
-    large the corpus is. Measured on the bench host: parquet decode
-    ~184 ns/row vs mmap open ~0; the one-time IPC write costs ~132 ns/row.
-
-    Disk usage is budgeted (``max_bytes``); once full, further files
-    simply re-decode parquet each epoch (same as no cache). Any IO error
-    degrades the same way. ``bytes_cached`` reports 0 — the budget
-    machinery (spill.make_budget_state) uses it to discount RESIDENT cache
-    growth from the transient-bytes ledger, and this cache pins no RAM.
-    """
-
-    def __init__(self, max_bytes: int, cache_dir: Optional[str] = None):
-        import tempfile as _tempfile
-        self.max_bytes = max_bytes
-        if cache_dir is None:
-            cache_dir = _tempfile.mkdtemp(prefix="rsdl_decoded_cache_")
-            self._owns_dir = True
-        else:
-            _os.makedirs(cache_dir, exist_ok=True)
-            self._owns_dir = False
-        self.cache_dir = cache_dir
-        self._bytes = 0
-        self._paths: Dict[str, Tuple[str, int]] = {}  # key -> (path, bytes)
-        self._inflight: set = set()  # keys with a write in progress
-        self._lock = threading.Lock()
-        self._closed = False
-
-    def _path_for(self, key: str) -> str:
-        import hashlib
-        digest = hashlib.sha1(key.encode()).hexdigest()[:16]
-        return _os.path.join(self.cache_dir, f"{digest}.arrow")
-
-    def _forget(self, key: str, path: str, nbytes: int) -> None:
-        """Drop a bad/stale entry: uncharge the budget, delete the file."""
-        with self._lock:
-            if self._paths.get(key, (None, 0))[0] == path:
-                del self._paths[key]
-                self._bytes -= nbytes
-        try:
-            _os.remove(path)
-        except OSError:
-            pass
-
-    def get(self, key: str) -> Optional[pa.Table]:
-        with self._lock:
-            entry = self._paths.get(key)
-        if entry is None:
-            return None
-        path, nbytes = entry
-        try:
-            with pa.memory_map(path) as source:
-                return pa.ipc.open_file(source).read_all()
-        except (OSError, pa.ArrowInvalid) as e:
-            logger.warning("decoded-cache read failed for %s (%s); "
-                           "re-decoding", key, e)
-            self._forget(key, path, nbytes)
-            return None
-
-    def put(self, key: str, table: pa.Table) -> bool:
-        """Write-if-budget-allows; returns True if the file was cached."""
-        nbytes = table.nbytes
-        with self._lock:
-            if self._closed:
-                return False
-            if key in self._paths:
-                return True
-            if key in self._inflight:
-                # Another epoch's map task is writing this key right now
-                # (concurrent epochs map the same files); it keeps its own
-                # decoded table for this epoch, the writer's file serves
-                # the next.
-                return False
-            if self._bytes + nbytes > self.max_bytes:
-                return False
-            # Reserve under the lock so concurrent map tasks cannot
-            # overshoot the budget together; release on failure below.
-            self._bytes += nbytes
-            self._inflight.add(key)
-        path = self._path_for(key)
-        # Writer-unique tmp name: _inflight already serializes same-key
-        # writers, this guards against a stale .tmp from a crashed run.
-        tmp_path = f"{path}.{id(table):x}.tmp"
-        try:
-            with pa.OSFile(tmp_path, "wb") as sink:
-                with pa.ipc.new_file(sink, table.schema) as writer:
-                    writer.write_table(table)
-            _os.replace(tmp_path, path)
-        except OSError as e:
-            logger.warning("decoded-cache write failed for %s (%s); "
-                           "cold reads continue from parquet", key, e)
-            with self._lock:
-                self._bytes -= nbytes
-                self._inflight.discard(key)
-            try:
-                _os.remove(tmp_path)
-            except OSError:
-                pass
-            return False
-        # Charge the REAL on-disk size against the budget, not
-        # table.nbytes: IPC framing, schema/footer metadata, and 8/64-byte
-        # alignment padding make the file larger than the raw column bytes
-        # (ADVICE r5 — the drift compounds over thousands of files and let
-        # the cache overshoot its disk budget).
-        try:
-            disk_bytes = _os.stat(path).st_size
-        except OSError:
-            disk_bytes = nbytes  # keep the reservation if stat fails
-        with self._lock:
-            self._inflight.discard(key)
-            self._bytes += disk_bytes - nbytes  # re-charge at actual size
-            if self._closed:  # closed while writing: drop the orphan
-                self._bytes -= disk_bytes
-                try:
-                    _os.remove(path)
-                except OSError:
-                    pass
-                return False
-            self._paths[key] = (path, disk_bytes)
-        return True
-
-    @property
-    def bytes_cached(self) -> int:
-        return 0  # pins no RAM (see class docstring)
-
-    @property
-    def disk_bytes(self) -> int:
-        with self._lock:
-            return self._bytes
-
-    def close(self) -> None:
-        """Delete cached files (safe even with live mmaps: POSIX keeps
-        unlinked mappings valid) and, if this cache made its own scratch
-        dir, the dir itself."""
-        with self._lock:
-            if self._closed:
-                return
-            self._closed = True
-            paths = [p for p, _ in self._paths.values()]
-            self._paths.clear()
-            self._bytes = 0
-        for path in paths:
-            try:
-                _os.remove(path)
-            except OSError:
-                pass
-        if self._owns_dir:
-            try:
-                _os.rmdir(self.cache_dir)
-            except OSError:
-                pass
+# DiskTableCache lived here through PR 13; it is now the legacy face of
+# storage.cache.DiskTier (same constructor, same no-eviction/no-ledger
+# semantics, plus per-entry CRC) and is re-exported above — the explicit
+# tier hierarchy, ledger charging, and promotion live in
+# storage.cache.TieredStore.
 
 
 def default_disk_cache_bytes(cache_dir: Optional[str] = None) -> int:
@@ -415,10 +266,14 @@ def resolve_file_cache(spec, epochs_remaining: int):
 
     ``spec`` is ``"auto"`` (RAM cache when >1 epoch will map each file),
     ``"disk"`` (fresh :class:`DiskTableCache`, budgeted by
-    ``default_disk_cache_bytes``), ``None``, or an instance. ``owned`` is
-    True when this call created a DiskTableCache the driver must close
-    after the run (its scratch files are useless to anyone else: reducer
-    outputs are gathered copies, never views of cached tables)."""
+    ``default_disk_cache_bytes``), ``"tiered"`` (a full
+    :class:`storage.cache.TieredStore`: hot RAM LRU over a ledger-charged
+    CRC'd disk tier over the installed storage source, with the
+    prefetcher seam the plan scheduler warms next-epoch files through),
+    ``None``, or an instance. ``owned`` is True when this call created a
+    disk-backed cache the driver must close after the run (its scratch
+    files are useless to anyone else: reducer outputs are gathered
+    copies, never views of cached tables)."""
     if spec == "auto":
         return (default_file_cache() if epochs_remaining > 1 else None,
                 False)
@@ -426,6 +281,15 @@ def resolve_file_cache(spec, epochs_remaining: int):
         if epochs_remaining <= 1:
             return None, False
         return DiskTableCache(max_bytes=default_disk_cache_bytes()), True
+    if spec == "tiered":
+        if epochs_remaining <= 1:
+            return None, False
+        ram = default_file_cache()
+        hot_bytes = ram.max_bytes if ram is not None else 1 << 30
+        return TieredStore(
+            hot_bytes,
+            disk=DiskTier(max_bytes=default_disk_cache_bytes()),
+            source=rt_storage.get_source()), True
     return spec, False
 
 
@@ -615,12 +479,11 @@ def _fused_stream_columns(filename: str, num_reducers: int, seed: int,
     batch's rows scatter to ``assign_dest_batch`` slots that reproduce the
     legacy counting sort's stable layout.
     """
-    import pyarrow.parquet as pq
     from ray_shuffling_data_loader_tpu import native
     if map_transform is not None and not getattr(
             map_transform, "row_elementwise", False):
         return None
-    pf = pq.ParquetFile(filename)
+    pf = rt_storage.open_parquet(filename, epoch=epoch, task=file_index)
     try:
         num_rows = pf.metadata.num_rows
         if num_rows <= 0 or num_rows >= 2**31:
@@ -696,24 +559,28 @@ def _fused_stream_map(filename: str, num_reducers: int, seed: int,
 def _read_map_table(filename: str, epoch: int, file_index: int,
                     read_retry: Optional[rt_retry.RetryPolicy],
                     inject: bool = True) -> pa.Table:
-    """The map task's Parquet read, as one named fault site plus an
-    in-place retry for transient IO errors (an NFS/GCS blip heals on
-    retry; a corrupt file does not, so ``ArrowInvalid`` is not retried
-    and surfaces to the quarantine policy in :func:`shuffle_map`).
+    """The map task's dataset read, as named fault sites plus an
+    in-place retry for transient IO errors (an NFS/GCS/remote blip
+    heals on retry; a corrupt file does not, so ``ArrowInvalid`` is not
+    retried and surfaces to the quarantine policy in
+    :func:`shuffle_map`). The bytes come from the installed
+    :mod:`storage` source — local disk, HTTP, or the simulated object
+    store — which is also where the ``storage_read``/``storage_stall``
+    chaos sites live.
 
     ``faults.inject`` sits OUTSIDE the retried read on purpose: an
     injected fault simulates a *lost task*, and must surface to the
     lineage-recovery machinery under test rather than be absorbed here.
-    ``inject=False`` skips the fault site — used when the caller already
-    fired it for this task (the streaming pipeline's ineligible-file
-    fallback) so one map task never consumes two injections.
+    ``inject=False`` skips the map_read fault site — used when the
+    caller already fired it for this task (the streaming pipeline's
+    ineligible-file fallback) so one map task never consumes two
+    map_read injections (the storage sites are exactly-once per key on
+    their own).
     """
     if inject:
         rt_faults.inject("map_read", epoch=epoch, task=file_index)
-    if read_retry is None:
-        return fileio.read_parquet(filename)
-    return read_retry.call(fileio.read_parquet, filename,
-                           describe=f"read {filename}")
+    return rt_storage.read_table(filename, epoch=epoch, task=file_index,
+                                 retry=read_retry)
 
 
 def shuffle_map(filename: str,
@@ -1428,6 +1295,15 @@ def _shuffle_epoch_thread(plan, pool, stats_collector, map_transform,
                                 policies.get("reduce"),
                                 _spill_recompute_for(reduce_index))
 
+    # Plan-driven cache warming (storage/prefetch.py): when the cache is
+    # a TieredStore, idle scheduler lanes warm the files the NEXT epoch
+    # re-reads (the plan's file list is the same every epoch). Below
+    # steal/speculation priority; canceled when real work lands.
+    prefetcher = None
+    maker = getattr(file_cache, "make_prefetcher", None)
+    if maker is not None and rt_policy.resolve("storage",
+                                               "storage_prefetch"):
+        prefetcher = maker(plan)
     scheduler = plan_sched.PlanScheduler(
         plan, pool,
         dispatchers={
@@ -1435,7 +1311,8 @@ def _shuffle_epoch_thread(plan, pool, stats_collector, map_transform,
                                                      attempt),
             "reduce": lambda node, attempt: pool.submit(_run_reduce, node,
                                                         attempt),
-        })
+        },
+        prefetcher=prefetcher)
     holder["scheduler"] = scheduler
     scheduler.start()
     return scheduler.refs("reduce")
@@ -1585,6 +1462,11 @@ def shuffle(filenames: Sequence[str],
         file_cache, owns_file_cache = resolve_file_cache(
             file_cache, num_epochs - start_epoch)
         budget_cache = file_cache
+        if hasattr(file_cache, "set_transform"):
+            # The cache stores TRANSFORMED tables (the map stage puts
+            # them post-transform); the prefetch warmer must apply the
+            # same hook or a warmed hit would change the stream.
+            file_cache.set_transform(map_transform)
     from ray_shuffling_data_loader_tpu.spill import make_budget_state
     _over_budget, spill_manager = make_budget_state(
         budget_cache, max_inflight_bytes, spill_dir)

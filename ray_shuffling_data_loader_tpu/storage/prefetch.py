@@ -1,0 +1,117 @@
+"""Plan-driven cache warming on idle scheduler lanes.
+
+The epoch plan is an explicit IR (plan/ir.py): epoch N's plan already
+names exactly which files the map stage reads — and epoch N+1 reads
+the same list. That turns prefetch from a heuristic (readahead,
+access-pattern guessing) into a lookup: any lane the scheduler has
+nothing real for can spend its idleness warming the tiered cache with
+the files the next epoch will fault on.
+
+Priority contract (enforced by plan/scheduler.py): real ready nodes
+first, then work stealing, then speculation — prefetch runs strictly
+below all three, and a lane occupied by a prefetch is treated as IDLE
+by the scheduler: arriving real work cancels the prefetch (best
+effort — a transfer already in flight finishes and still warms the
+cache; only its lane bookkeeping is released immediately).
+
+Accounting: ``issued`` counts prefetches that actually started,
+``canceled`` those the scheduler reclaimed before start, and ``hits``
+(counted by the TieredStore) prefetched entries a real map task later
+consumed. ``efficiency = hits / issued`` is the honest number — a
+prefetcher that warms files nobody reads reports it.
+
+Failures are deliberately swallowed (logged, counted as a miss by
+omission): prefetch is an optimization, and the real read path owns
+retries, quarantine, and chaos-fault surfacing for the task that
+actually needs the bytes.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+from typing import Optional
+
+from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+
+class PrefetchTask:
+    """One cancelable warm-one-file unit of lane work."""
+
+    __slots__ = ("manager", "path", "_cancel", "_started")
+
+    def __init__(self, manager: "PrefetchManager", path: str):
+        self.manager = manager
+        self.path = path
+        self._cancel = threading.Event()
+        self._started = threading.Event()
+
+    def cancel(self) -> None:
+        """Scheduler-side reclaim: a real task needs the lane. A task
+        that never started is counted canceled; one already fetching
+        finishes (and still warms the cache)."""
+        self._cancel.set()
+        if not self._started.is_set():
+            self.manager._canceled.inc()
+
+    def run(self) -> bool:
+        """Pool-side body; returns True when the entry became (or
+        already was) resident."""
+        if self._cancel.is_set():
+            return False
+        self._started.set()
+        self.manager._issued.inc()
+        try:
+            return self.manager.store.warm(self.path)
+        except Exception as e:  # noqa: BLE001 - optimization, not truth
+            logger.debug("prefetch of %s failed (%s); the real read "
+                         "path will fetch it", self.path, e)
+            return False
+
+
+class PrefetchManager:
+    """Hands the scheduler one :class:`PrefetchTask` at a time, in plan
+    order, skipping files already resident in the store."""
+
+    def __init__(self, store, files):
+        self.store = store
+        self._pending = deque(files)
+        self._lock = threading.Lock()
+        self._issued = rt_metrics.counter(
+            "rsdl_storage_prefetch_issued_total",
+            "prefetch tasks that started fetching")
+        self._canceled = rt_metrics.counter(
+            "rsdl_storage_prefetch_canceled_total",
+            "prefetch tasks reclaimed by real work before starting")
+
+    def next(self) -> Optional[PrefetchTask]:
+        """The next non-resident file as a task; None when drained.
+        Bounded: every pass pops one pending entry."""
+        while self._pending:
+            with self._lock:
+                if not self._pending:
+                    return None
+                path = self._pending.popleft()
+            try:
+                if self.store.resident(path):
+                    continue
+            except Exception:  # noqa: BLE001 - residency probe only
+                continue
+            return PrefetchTask(self, path)
+
+    def stats(self) -> dict:
+        """{issued, canceled, hits, efficiency} — hits come from the
+        store's prefetch-hit counter (a hit is only countable where
+        the consuming get() runs)."""
+        issued = self._issued.value
+        hits = rt_metrics.counter(
+            "rsdl_storage_prefetch_hits_total").value
+        return {
+            "issued": issued,
+            "canceled": self._canceled.value,
+            "hits": hits,
+            "efficiency": hits / issued if issued else 0.0,
+        }

@@ -530,6 +530,24 @@ SENDALL_LOOP_OK = """
             sock.sendall(b"ping")
 """
 
+RAW_DATASET_READ_BAD = """
+    import pyarrow.parquet as pq
+
+    def load(path):
+        table = pq.read_table(path)
+        meta = pq.ParquetFile(path)
+        return table, meta
+"""
+
+RAW_DATASET_READ_OK = """
+    from ray_shuffling_data_loader_tpu import storage
+
+    def load(path, epoch, task):
+        table = storage.read_table(path, epoch=epoch, task=task)
+        meta = storage.open_parquet(path, epoch=epoch, task=task)
+        return table, meta
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -566,6 +584,8 @@ CASES = [
      {"path": "ray_shuffling_data_loader_tpu/multiqueue_service.py"}),
     ("lineage-outside-plan", LINEAGE_PLAN_SEEDSEQ_BAD, LINEAGE_PLAN_OK,
      {"path": "ray_shuffling_data_loader_tpu/workers.py"}),
+    ("raw-dataset-read", RAW_DATASET_READ_BAD, RAW_DATASET_READ_OK,
+     {"path": "ray_shuffling_data_loader_tpu/shuffle.py"}),
 ]
 
 
@@ -589,6 +609,21 @@ def test_unregistered_metric_scoped_to_library_code():
     assert "unregistered-metric" not in flagged
     flagged, _ = lint(UNREGISTERED_METRIC_BAD, path="bench.py")
     assert "unregistered-metric" in flagged
+
+
+def test_raw_dataset_read_scoped_and_exempt():
+    """storage/ and utils/fileio.py are the blessed homes of raw
+    parquet IO; tests and tools read datasets freely."""
+    for exempt in ("ray_shuffling_data_loader_tpu/storage/source.py",
+                   "ray_shuffling_data_loader_tpu/utils/fileio.py",
+                   "tests/test_x.py", "tools/rsdl_microbench.py"):
+        flagged, _ = lint(RAW_DATASET_READ_BAD, path=exempt)
+        assert "raw-dataset-read" not in flagged, exempt
+    flagged, violations = lint(RAW_DATASET_READ_BAD, path="bench.py")
+    assert "raw-dataset-read" in flagged
+    # read_table and ParquetFile are each their own finding.
+    assert sum(1 for v in violations
+               if v.rule == "raw-dataset-read") == 2
 
 
 def test_metric_catalog_covers_every_registered_name():

@@ -75,6 +75,16 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
      "slack": 100.0},
     {"key": "freshness_p99_ms", "mode": "higher_bad", "pct": 150.0,
      "slack": 150.0},
+    # Storage-plane cold leg (storage/): prefetch-on ingest rate against
+    # the simulated object store, and the prefetch A/B speedup itself —
+    # a prefetch regression (lanes never idle, warms the wrong files,
+    # cancels everything) can hide behind a faster host's absolute
+    # rows/s while the on-vs-off ratio collapses toward 1.0. The sim's
+    # sleeps are deterministic but thread scheduling is not, so the
+    # thresholds are wide.
+    {"key": "remote_rows_per_sec", "mode": "lower_bad", "pct": 20.0},
+    {"key": "remote_prefetch_speedup_x", "mode": "lower_bad",
+     "pct": 25.0},
 ]
 
 

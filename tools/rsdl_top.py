@@ -204,6 +204,45 @@ def render_shards(parsed: dict) -> list:
     return lines
 
 
+def render_storage(parsed: dict) -> list:
+    """Per-tier storage-cache lines (storage/cache.py): hit share,
+    eviction/corruption counts and resident bytes per tier, plus the
+    prefetch plane's issued/hit/canceled efficiency and total remote
+    bytes — the "is the cold path actually caching" one-liner."""
+    hits = _by_label(parsed, "rsdl_storage_hits_total", "tier")
+    misses = _by_label(parsed, "rsdl_storage_misses_total", "tier")
+    evictions = _by_label(parsed, "rsdl_storage_evictions_total", "tier")
+    corrupt = _by_label(parsed, "rsdl_storage_corrupt_total", "tier")
+    tier_bytes = _by_label(parsed, "rsdl_storage_tier_bytes", "tier")
+    tiers = [t for t in ("hot", "disk", "remote")
+             if t in set(hits) | set(misses) | set(tier_bytes)]
+    if not tiers:
+        return []
+    lines = ["storage tiers:"]
+    for tier in tiers:
+        h, m = hits.get(tier, 0.0), misses.get(tier, 0.0)
+        hit_pct = 100.0 * h / (h + m) if h + m else 0.0
+        line = (f"  {tier:<6} hit {hit_pct:5.1f}% ({int(h)}/{int(h + m)})"
+                f"  bytes {_human_bytes(tier_bytes.get(tier, 0.0)):>10}")
+        if evictions.get(tier):
+            line += f"  evicted {int(evictions[tier])}"
+        if corrupt.get(tier):
+            line += f"  CORRUPT {int(corrupt[tier])}"
+        lines.append(line)
+    issued = _scalar(parsed, "rsdl_storage_prefetch_issued_total")
+    if issued:
+        p_hits = _scalar(parsed, "rsdl_storage_prefetch_hits_total")
+        canceled = _scalar(parsed, "rsdl_storage_prefetch_canceled_total")
+        lines.append(f"  prefetch: {int(issued)} issued  "
+                     f"{int(p_hits)} hit "
+                     f"({100.0 * p_hits / issued:.0f}% efficient)  "
+                     f"{int(canceled)} canceled")
+    remote = _scalar(parsed, "rsdl_storage_remote_bytes_read_total")
+    if remote:
+        lines.append(f"  remote bytes read: {_human_bytes(remote)}")
+    return lines
+
+
 def render_latency(parsed: dict, before: dict = None) -> list:
     """Per-queue delivery-latency lines (runtime/latency.py sketch):
     p50/p95/p99 of the end-to-end birth->delivered hop plus the queue's
@@ -353,6 +392,7 @@ def render(parsed: dict, before: dict = None, interval_s: float = None
             f"frames replayed: {int(replayed)}   "
             f"server restarts: {int(restarts)}")
     lines.extend(render_shards(parsed))
+    lines.extend(render_storage(parsed))
     lines.extend(render_latency(parsed, before=before if rate_mode
                                 else None))
     # Critical-path line (runtime/trace.py gauges, refreshed per epoch):
