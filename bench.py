@@ -37,9 +37,11 @@ Env knobs: RSDL_BENCH_ROWS, RSDL_BENCH_FILES, RSDL_BENCH_EPOCHS,
 RSDL_BENCH_BATCH, RSDL_BENCH_PREFETCH (batches in flight, default 4),
 RSDL_BENCH_CPU=1 (force CPU backend for smoke runs),
 RSDL_BENCH_PHASES (csv subset of
-"cached,cold,train,scaling,serve,latency,remote", default all; the
-remote phase is the storage-plane cold leg — simulated object store,
-tiered cache thrash regime, prefetch ON vs OFF at the same seed),
+"cached,cold,train,scaling,serve,latency,remote,stream", default all;
+the remote phase is the storage-plane cold leg — simulated object
+store, tiered cache thrash regime, prefetch ON vs OFF at the same
+seed; the stream phase is the streaming leg — synthetic event source,
+windowed shuffle, served end-to-end through device transfer),
 RSDL_BENCH_COLD=1 (legacy: make the cold phase the headline and skip
 cached), RSDL_BENCH_COLD_EPOCHS (default 6),
 RSDL_BENCH_COLD_CACHE=disk|none (default disk — see phase 2 above),
@@ -1513,6 +1515,162 @@ def _run_latency_leg(filenames, seed: int = 0,
     return result
 
 
+def _run_stream_leg(seed: int = 0, windows: int = 3,
+                    files_per_window: int = 2,
+                    rows_per_file: int = 4_096) -> dict:
+    """Streaming leg (streaming/): a seeded ``SyntheticEventSource``
+    drives ``windows`` count-bounded windows through the
+    :class:`StreamingShuffleRunner` while one remote trainer drains the
+    served stream through a real ``JaxShufflingDataset`` (convert +
+    device transfer) — window N+1 assembles and shuffles UNDER window
+    N's serve (``max_concurrent_epochs=2``), so the per-window watermark
+    lag samples measure the real pipelining gap, in stream seconds.
+    Freshness is the PR 11 birth->device sketch measured on LIVE
+    windows (the same plane the latency leg gates on static epochs),
+    reported as ``stream_freshness_p99_ms`` so the two legs never
+    collide in one record. Hermetic: the drifting click stream is
+    generated into a fresh tempdir and every arrival is a pure function
+    of ``(seed, event_index)``.
+    """
+    import tempfile
+    import threading
+
+    from ray_shuffling_data_loader_tpu import multiqueue as mq
+    from ray_shuffling_data_loader_tpu import multiqueue_service as svc
+    from ray_shuffling_data_loader_tpu.jax_dataset import JaxShufflingDataset
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+    from ray_shuffling_data_loader_tpu.runtime import latency as rt_lat
+    from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+    from ray_shuffling_data_loader_tpu.streaming import (
+        StreamingShuffleRunner, SyntheticEventSource)
+    from ray_shuffling_data_loader_tpu.streaming import window as st_window
+    from ray_shuffling_data_loader_tpu.workloads import dlrm_criteo
+
+    num_files = windows * files_per_window
+    total_rows = num_files * rows_per_file
+    series = "rsdl_delivery_latency_seconds_centroid"
+    close_hist = rt_metrics.histogram(
+        "rsdl_stream_window_close_seconds",
+        "wall time from a window's first event to its seal")
+
+    def _snapshot() -> dict:
+        return dict(rt_metrics.parse_exposition(
+            rt_metrics.render()).get(series, {}))
+
+    def _device_p99_ms(now: dict, base: dict):
+        counts: dict = {}
+        for labels, value in now.items():
+            delta = value - base.get(labels, 0.0)
+            d = dict(labels)
+            if (delta <= 0 or d.get("hop") != rt_lat.HOP_BIRTH_TO_DEVICE
+                    or "c" not in d):
+                continue
+            centroid = float(d["c"])
+            counts[centroid] = counts.get(centroid, 0.0) + delta
+        total = int(sum(counts.values()))
+        if not total:
+            return None
+        return round(
+            rt_metrics._centroid_quantile(counts, total, 0.99) * 1e3, 3)
+
+    with tempfile.TemporaryDirectory(prefix="rsdl-bench-stream-") as td:
+        files = dlrm_criteo.generate_drifting_stream(
+            num_files, rows_per_file, td, seed=seed)
+        source = SyntheticEventSource(files, seed=seed,
+                                      total_events=num_files)
+        queue = mq.MultiQueue(windows)
+        lag_samples: list = []
+        holder: dict = {}
+
+        def consumer(rank, epoch, refs):
+            queue_idx = plan_ir.queue_index(epoch, rank, 1)
+            if refs is None:
+                queue.put(queue_idx, None)
+            else:
+                queue.put_batch(queue_idx, list(refs))
+
+        def on_window_served(_window_index: int) -> None:
+            runner = holder["runner"]
+            ingest = runner.assembler.ingest_watermark
+            serve = runner.serve_watermark
+            if ingest != float("-inf") and serve != float("-inf"):
+                lag_samples.append(max(0.0, ingest - serve))
+
+        runner = StreamingShuffleRunner(
+            source, consumer, num_reducers=max(2, files_per_window),
+            num_trainers=1, seed=seed, max_concurrent_epochs=2,
+            policy=st_window.WindowPolicy(max_files=files_per_window),
+            on_window_served=on_window_served)
+        holder["runner"] = runner
+
+        close_before = (close_hist.sum, close_hist.count)
+        lat_before = _snapshot()
+        rows_holder = {"rows": 0}
+        errors: list = []
+        start = timeit.default_timer()
+        with svc.serve_queue_sharded(queue, num_shards=1,
+                                     num_trainers=1) as sharded:
+
+            def drain() -> None:
+                try:
+                    remote = svc.ShardedRemoteQueue(sharded.shard_map,
+                                                    max_batch=2)
+                    ds = JaxShufflingDataset(
+                        files, num_epochs=windows, num_trainers=1,
+                        batch_size=8_192, rank=0, batch_queue=remote,
+                        shuffle_result=None, seed=seed, prefetch_size=2,
+                        drop_last=False, **dlrm_criteo.dlrm_spec())
+                    try:
+                        for epoch in plan_ir.epoch_range(0, windows):
+                            ds.set_epoch(epoch)
+                            for _features, label in ds:
+                                rows_holder["rows"] += int(label.shape[0])
+                    finally:
+                        ds.close()
+                        remote.close()
+                except BaseException as e:  # noqa: BLE001 - re-raised
+                    errors.append(e)
+
+            trainer = threading.Thread(target=drain, daemon=True,
+                                       name="bench-stream-trainer")
+            trainer.start()
+            summary = runner.run()
+            trainer.join(timeout=300)
+        duration_s = timeit.default_timer() - start
+        queue.shutdown()
+        runner.close()
+        if errors:
+            raise errors[0]
+        rows_delivered = rows_holder["rows"]
+        if rows_delivered != total_rows:
+            raise RuntimeError(
+                f"stream leg delivered {rows_delivered} rows, expected "
+                f"{total_rows} — the windowed stream lost or duplicated "
+                "rows")
+
+    lag_p99 = 0.0
+    if lag_samples:
+        ordered = sorted(lag_samples)
+        lag_p99 = ordered[min(len(ordered) - 1,
+                              int(0.99 * len(ordered)))]
+    close_sum = close_hist.sum - close_before[0]
+    close_count = close_hist.count - close_before[1]
+    result = {
+        "stream_windows": summary["windows_served"],
+        "stream_events": summary["events_sealed"],
+        "stream_rows_per_sec": round(total_rows / duration_s, 1),
+        "stream_duration_s": round(duration_s, 3),
+        "watermark_lag_p99_s": round(lag_p99, 6),
+        "late_events": summary["late_events"],
+        "window_close_ms": round(1e3 * close_sum / close_count, 3)
+        if close_count else 0.0,
+    }
+    fresh = _device_p99_ms(_snapshot(), lat_before)
+    if fresh is not None:
+        result["stream_freshness_p99_ms"] = fresh
+    return result
+
+
 def main() -> None:
     if os.environ.get("RSDL_BENCH_CPU"):
         os.environ.setdefault(
@@ -1623,7 +1781,7 @@ def main() -> None:
 
     phases = [p.strip() for p in os.environ.get(
         "RSDL_BENCH_PHASES",
-        "cached,cold,train,scaling,serve,latency,remote").split(",")
+        "cached,cold,train,scaling,serve,latency,remote,stream").split(",")
         if p.strip()]
     if os.environ.get("RSDL_BENCH_COLD"):
         # Legacy knob: the cold regime IS the headline; skip cached.
@@ -1662,7 +1820,7 @@ def main() -> None:
     recovery_before = rsdl_stats.process_recovery_totals()
 
     cached = cold = train = train_agg = scaling = serve = latency = None
-    remote = None
+    remote = stream = None
 
     def _phase(name, fn):
         """Run one phase; a failed phase is reported and OMITTED from the
@@ -1798,6 +1956,20 @@ def main() -> None:
                       f"{latency['latency_shards']} shards; freshness "
                       f"p99 {latency.get('freshness_p99_ms', 'n/a')}ms",
                       file=sys.stderr)
+        if "stream" in phases:
+            stream = _phase("stream", lambda: _run_stream_leg(
+                int(os.environ.get("RSDL_BENCH_SEED", "0"))))
+            if stream is not None:
+                print(f"# stream: "
+                      f"{stream['stream_rows_per_sec']:,.0f} rows/s "
+                      f"end-to-end over {stream['stream_windows']} "
+                      f"windows ({stream['stream_events']} events); "
+                      f"watermark lag p99 "
+                      f"{stream['watermark_lag_p99_s']}s; window close "
+                      f"{stream['window_close_ms']}ms; late "
+                      f"{stream['late_events']}; freshness p99 "
+                      f"{stream.get('stream_freshness_p99_ms', 'n/a')}ms",
+                      file=sys.stderr)
         if "train" in phases:
             train_epochs = int(os.environ.get("RSDL_BENCH_TRAIN_EPOCHS", 4))
             train_batch = int(os.environ.get("RSDL_BENCH_TRAIN_BATCH",
@@ -1916,6 +2088,16 @@ def main() -> None:
                         remote["remote_epochs"],
                     "duration_s": 0.0}
         metric = "remote_cold_rows_per_sec"
+    elif stream is not None:
+        # Stream-only run (RSDL_BENCH_PHASES=stream): the headline is
+        # the windowed end-to-end rate — assemble -> shuffle -> serve ->
+        # device — over the synthetic stream (streaming/).
+        headline = {"rows_per_s": stream["stream_rows_per_sec"],
+                    "stall_pct": 0.0, "stall_s": 0.0,
+                    "wait_mean_ms": 0.0,
+                    "timed_epochs": stream["stream_windows"],
+                    "duration_s": stream["stream_duration_s"]}
+        metric = "stream_rows_per_sec"
     else:
         print(f"no phase produced a result (selected: {phases!r}; a "
               "'# <name> phase FAILED' line above means the phase ran "
@@ -2001,6 +2183,12 @@ def main() -> None:
         # like any other metric — the prefetch-on-beats-off contract is
         # an artifact in the record, not a claim in prose.
         record.update(remote)
+    if stream is not None:
+        # Streaming leg (streaming/): flat keys so the bench-diff gate
+        # reads stream_rows_per_sec / watermark_lag_p99_s /
+        # window_close_ms like any other metric — the rules skip
+        # cleanly against pre-streaming baselines that lack them.
+        record.update(stream)
     # Runtime-health evidence (runtime/watchdog.py): deadline misses on
     # the supervised bulk transfer/carve path, escalations (a stall
     # persisting past further deadline multiples), and whether the

@@ -124,6 +124,18 @@ class Config:
     dataset_read_exempt_globs: Tuple[str, ...] = (
         "*ray_shuffling_data_loader_tpu/storage/*",
         "*ray_shuffling_data_loader_tpu/utils/fileio.py")
+    # fnmatch patterns of library files where counting epochs with
+    # range(num_epochs) (or literal-epoch indexing of per-epoch state)
+    # is a static-epoch-assumption violation — the epoch sequence
+    # belongs to plan/ so unbounded streaming input keeps working.
+    static_epoch_globs: Tuple[str, ...] = (
+        "ray_shuffling_data_loader_tpu/*",)
+    # Exempt: plan/ enumerates the schedule (epoch_range /
+    # static_epoch_specs live there) and streaming/ derives epochs from
+    # windows by construction.
+    static_epoch_exempt_globs: Tuple[str, ...] = (
+        "*ray_shuffling_data_loader_tpu/plan/*",
+        "*ray_shuffling_data_loader_tpu/streaming/*")
 
     @classmethod
     def from_dict(cls, data: dict) -> "Config":

@@ -127,3 +127,71 @@ class LineageOutsidePlanRule(Rule):
                 "plan/ops — derive task RNG through the plan's lineage "
                 "keys (ops.partition map_rng/reduce_rng)")
         return None
+
+
+@register
+class StaticEpochAssumptionRule(Rule):
+    id = "static-epoch-assumption"
+    category = "plan"
+    description = ("library code counting epochs with range(num_epochs) "
+                   "or indexing per-epoch state by a literal epoch — the "
+                   "epoch sequence belongs to plan/ "
+                   "(plan.ir.epoch_range / static_epoch_specs); a static "
+                   "count silently breaks unbounded streaming input")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        if not ctx.path_matches(ctx.config.static_epoch_globs):
+            return
+        if ctx.path_matches(ctx.config.static_epoch_exempt_globs):
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                violation = self._check_range(node, ctx)
+                if violation is not None:
+                    yield violation
+            elif isinstance(node, ast.Subscript):
+                violation = self._check_subscript(node, ctx)
+                if violation is not None:
+                    yield violation
+
+    def _check_range(self, node: ast.Call, ctx: FileContext):
+        # `range(num_epochs)` / `range(start, self.num_epochs)`: a hard
+        # assumption that the trial's epoch count is finite and known up
+        # front. Streaming windows arrive as epochs with no count;
+        # plan.ir.epoch_range handles both shapes (None = unbounded) and
+        # plan.ir.static_epoch_specs IS the bounded schedule.
+        func = node.func
+        if not (isinstance(func, ast.Name) and func.id == "range"):
+            return None
+        for arg in node.args:
+            if _mentions(_name_words(arg), "num_epochs"):
+                return ctx.violation(
+                    self, node,
+                    "epochs counted with range(..num_epochs..); iterate "
+                    "plan.ir.epoch_range(start, num_epochs) (None = "
+                    "unbounded stream) or consume "
+                    "plan.ir.static_epoch_specs")
+        return None
+
+    def _check_subscript(self, node: ast.Subscript, ctx: FileContext):
+        # `epoch_refs[2]` / `per_epoch[0]`: per-epoch state indexed by a
+        # literal epoch — code that can only be correct for one frozen
+        # epoch numbering. Dynamic indices (loop variables, plan-derived
+        # epochs) are fine.
+        if not isinstance(node.slice, ast.Constant):
+            return None
+        if not isinstance(node.slice.value, int):
+            return None
+        words = _name_words(node.value)
+        per_epoch = any(
+            ("epoch" in w and ("ref" in w or "plan" in w or "queue" in w))
+            or w in ("per_epoch", "epochs")
+            for w in words)
+        if per_epoch:
+            return ctx.violation(
+                self, node,
+                "per-epoch state indexed by a literal epoch number — "
+                "derive the index from the plan (plan.ir.queue_index / "
+                "the EpochSpec being served), not a frozen count")
+        return None

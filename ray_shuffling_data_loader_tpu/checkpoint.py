@@ -286,6 +286,86 @@ class WatermarkJournal:
                 self._file = None
 
 
+class StreamJournal:
+    """Crc'd append-only journal of INGEST-side stream records — the
+    :class:`WatermarkJournal` line discipline (crc-covered canonical
+    JSON, torn tails skipped on load) applied to the other end of the
+    pipe. Delivery watermarks journal what consumers have durably seen;
+    this journals what the stream has durably *admitted*:
+
+    - ``DirectoryTailSource`` manifest records (``{"kind": "file", "n",
+      "path", "size"}``): the discovery ORDER of arriving files, so a
+      recovered source re-discovers the identical sequence regardless of
+      directory-listing order.
+    - window ingest watermarks (``{"kind": "watermark", "window",
+      "events", "files"}``, ``streaming/window.py``): monotone count of
+      events sealed into closed windows — the minuend of the
+      ``watermark_lag`` health detector.
+
+    Records are appended durably (flush + fsync) by default: a
+    ``kill -9`` between a window close and its journal record re-closes
+    the same window from the manifest, which is idempotent because
+    window assembly is deterministic in the admitted-event order.
+    """
+
+    def __init__(self, path: str):
+        self._path = path
+        self._lock = threading.Lock()
+        self._file = None
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def append(self, entry: dict, durable: bool = True) -> None:
+        line = WatermarkJournal._encode(dict(entry)) + "\n"
+        with self._lock:
+            if self._file is None:
+                directory = os.path.dirname(os.path.abspath(self._path))
+                os.makedirs(directory, exist_ok=True)
+                self._file = open(self._path, "a", encoding="utf-8")
+            self._file.write(line)
+            self._file.flush()
+            if durable:
+                os.fsync(self._file.fileno())
+
+    @classmethod
+    def load(cls, path: str) -> "list[dict]":
+        """Every intact record, in append order; lines with a bad or
+        missing CRC (torn tail) are skipped with a warning."""
+        from ray_shuffling_data_loader_tpu import native
+        entries: "list[dict]" = []
+        if not os.path.exists(path):
+            return entries
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    record = json.loads(line)
+                    entry = record["entry"]
+                    body = json.dumps(entry, sort_keys=True,
+                                      separators=(",", ":"))
+                    if native.crc32(body.encode()) & 0xFFFFFFFF != \
+                            record["crc"]:
+                        raise ValueError("crc mismatch")
+                except (ValueError, KeyError, TypeError) as e:
+                    logger.warning(
+                        "stream journal %s line %d unreadable (%s); "
+                        "skipping (torn tail from a crash is expected)",
+                        path, lineno, e)
+                    continue
+                entries.append(entry)
+        return entries
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
 def resume_iterator(dataset,
                     checkpoint: LoaderCheckpoint,
                     checkpoint_path: Optional[str] = None,
@@ -334,7 +414,9 @@ def resume_iterator(dataset,
             if commit is not None:
                 commit()
 
-    for epoch in range(checkpoint.epoch, checkpoint.num_epochs):
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+    for epoch in plan_ir.epoch_range(checkpoint.epoch,
+                                     checkpoint.num_epochs):
         skip = checkpoint.batches_consumed if epoch == checkpoint.epoch else 0
         checkpoint.epoch = epoch
         fallback_skip = 0

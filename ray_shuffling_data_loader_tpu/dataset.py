@@ -201,7 +201,7 @@ class ShufflingDataset:
 
     def __init__(self,
                  filenames: Sequence[str],
-                 num_epochs: int,
+                 num_epochs: Optional[int],
                  num_trainers: int,
                  batch_size: int,
                  rank: int,
@@ -227,6 +227,17 @@ class ShufflingDataset:
 
         self._owns_queue = False
         if batch_queue is None:
+            if rank == 0 and num_epochs is None:
+                # Unbounded (streaming) consumption is pure-consumer:
+                # epochs are produced by a streaming runner or a
+                # supervised queue server whose window schedule bounds
+                # the queue count — this constructor cannot size a
+                # queue for "forever".
+                raise ValueError(
+                    "num_epochs=None (unbounded streaming) requires a "
+                    "batch_queue from the streaming serving plane; "
+                    "rank 0 cannot launch a static shuffle without an "
+                    "epoch count")
             if rank == 0:
                 self._batch_queue, self._shuffle_result = (
                     create_batch_queue_and_shuffle(
@@ -250,9 +261,12 @@ class ShufflingDataset:
             self._batch_queue = batch_queue
             self._shuffle_result = shuffle_result
 
-        if not 0 <= start_epoch <= num_epochs:
+        if num_epochs is not None and not 0 <= start_epoch <= num_epochs:
             raise ValueError(
                 f"start_epoch {start_epoch} out of range [0, {num_epochs}]")
+        if num_epochs is None and start_epoch < 0:
+            raise ValueError(
+                f"start_epoch {start_epoch} must be >= 0")
         self._start_epoch = start_epoch
         self._num_epochs = num_epochs
         self._num_trainers = num_trainers
@@ -283,7 +297,8 @@ class ShufflingDataset:
         return self._seed
 
     @property
-    def num_epochs(self) -> int:
+    def num_epochs(self) -> Optional[int]:
+        """Epoch count of the trial; None means unbounded (streaming)."""
         return self._num_epochs
 
     @property
@@ -410,7 +425,8 @@ class ShufflingDataset:
         # (first completion wins — the JAX binding's consumer-side end
         # calls this too, whichever finishes first).
         rt_telemetry.epoch_complete(self._epoch, source="dataset")
-        if (self._epoch == self._num_epochs - 1
+        if (self._num_epochs is not None
+                and self._epoch == self._num_epochs - 1
                 and self._shuffle_result is not None):
             # Join the shuffle driver (reference: dataset.py:208-210), then
             # release the queue's name so a later trial in the same process
@@ -517,7 +533,7 @@ if __name__ == "__main__":
                               rank=0,
                               num_reducers=args.num_reducers,
                               max_concurrent_epochs=args.max_concurrent_epochs)
-        for epoch in range(args.num_epochs):
+        for epoch in plan_ir.epoch_range(0, args.num_epochs):
             ds.set_epoch(epoch)
             rows = batches = 0
             for batch in ds:

@@ -33,6 +33,7 @@ from typing import Any, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
+from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
 from ray_shuffling_data_loader_tpu.dataset import (ShufflingDataset,
                                                    slice_batches)
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
@@ -535,7 +536,12 @@ def _persistent_producer(dataset: ShufflingDataset,
         return False
 
     try:
-        for epoch in range(dataset.start_epoch, dataset.num_epochs):
+        # plan.ir.epoch_range: a bounded range for a classic trial, an
+        # unbounded count when the dataset consumes a stream
+        # (num_epochs=None) — the producer keeps entering epochs as
+        # windows close server-side.
+        for epoch in plan_ir.epoch_range(dataset.start_epoch,
+                                         dataset.num_epochs):
             with lock:
                 started_epochs.add(epoch)
                 skip = pending_skips.pop(epoch, 0)
@@ -884,7 +890,7 @@ class JaxShufflingDataset:
 
     def __init__(self,
                  filenames: Sequence[str],
-                 num_epochs: int,
+                 num_epochs: Optional[int],
                  num_trainers: int,
                  batch_size: int,
                  rank: int,
@@ -1125,7 +1131,8 @@ class JaxShufflingDataset:
         return self._dataset.seed
 
     @property
-    def num_epochs(self) -> int:
+    def num_epochs(self) -> Optional[int]:
+        """Epoch count; None means unbounded streaming consumption."""
         return self._dataset.num_epochs
 
     def _convert(self, table: pa.Table):

@@ -2045,7 +2045,17 @@ def serve_pipeline(config: dict):
     import importlib
     sh = importlib.import_module("ray_shuffling_data_loader_tpu.shuffle")
 
-    num_epochs = int(config["num_epochs"])
+    # Streaming mode: ``config["epochs"]`` is a FROZEN window schedule
+    # (one ``{"epoch", "filenames", "window"}`` record per closed window,
+    # ``streaming/window.py``). The schedule is data in the config, so a
+    # restarted incarnation re-derives the identical epoch sequence —
+    # the window-boundary half of the exactly-once proof; the journal
+    # half below is epoch-generic and applies unchanged.
+    stream_epochs = config.get("epochs")
+    if stream_epochs is not None:
+        num_epochs = len(stream_epochs)
+    else:
+        num_epochs = int(config["num_epochs"])
     num_trainers = int(config["num_trainers"])
     num_shards = int(config.get("num_shards", 1))
     shard_index = int(config.get("shard_index", 0))
@@ -2085,16 +2095,46 @@ def serve_pipeline(config: dict):
     queue = mq.MultiQueue(num_epochs * num_trainers)
     consumer = _resuming_batch_consumer(queue, num_trainers, skip_items,
                                         owned_ranks=owned_ranks)
-    shuffle_result = sh.run_shuffle_in_background(
-        list(config["filenames"]), consumer, num_epochs,
-        int(config["num_reducers"]), num_trainers,
-        int(config.get("max_concurrent_epochs", 2)),
-        seed=int(config.get("seed", 0)),
-        num_workers=config.get("num_workers"),
-        collect_stats=False, start_epoch=start_epoch,
-        file_cache=config.get("file_cache", "auto"),
-        on_failure=ds.make_failure_broadcaster(
-            queue, num_epochs * num_trainers))
+    if stream_epochs is not None:
+        specs = [plan_ir.EpochSpec(
+                     epoch=int(e["epoch"]),
+                     filenames=tuple(str(f) for f in e["filenames"]),
+                     window=(dict(e["window"])
+                             if e.get("window") is not None else None))
+                 for e in stream_epochs]
+        specs = [s for s in specs if s.epoch >= start_epoch]
+        serve_gauge = rt_metrics.gauge(
+            "rsdl_stream_serve_watermark",
+            "stream time fully handed to the serving plane")
+
+        def _on_epoch_done(epoch: int,
+                           by_epoch={s.epoch: s for s in specs}) -> None:
+            spec = by_epoch.get(epoch)
+            watermark = (spec.window or {}).get("ingest_watermark") \
+                if spec is not None else None
+            if watermark is not None:
+                serve_gauge.set(float(watermark))
+
+        shuffle_result = sh.run_shuffle_epochs_in_background(
+            specs, consumer, int(config["num_reducers"]), num_trainers,
+            int(config.get("max_concurrent_epochs", 2)),
+            seed=int(config.get("seed", 0)),
+            num_workers=config.get("num_workers"),
+            file_cache=config.get("file_cache", "auto"),
+            epochs_hint=len(specs), on_epoch_done=_on_epoch_done,
+            on_failure=ds.make_failure_broadcaster(
+                queue, num_epochs * num_trainers))
+    else:
+        shuffle_result = sh.run_shuffle_in_background(
+            list(config["filenames"]), consumer, num_epochs,
+            int(config["num_reducers"]), num_trainers,
+            int(config.get("max_concurrent_epochs", 2)),
+            seed=int(config.get("seed", 0)),
+            num_workers=config.get("num_workers"),
+            collect_stats=False, start_epoch=start_epoch,
+            file_cache=config.get("file_cache", "auto"),
+            on_failure=ds.make_failure_broadcaster(
+                queue, num_epochs * num_trainers))
     server = QueueServer(
         queue, (config.get("host", "127.0.0.1"), int(config["port"])),
         num_trainers=num_trainers, journal=journal, initial_state=state,

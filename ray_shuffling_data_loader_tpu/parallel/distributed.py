@@ -296,8 +296,14 @@ def shuffle_distributed(filenames: Sequence[str],
         pool = ex.Executor(num_workers=num_workers,
                            task_retries=task_retries)
     try:
+        from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
         in_progress: Dict[int, List[ex.TaskRef]] = {}
-        for epoch_idx in range(start_epoch, num_epochs):
+        # Epoch schedule comes from the plan layer (the static-epoch-
+        # assumption contract): the multi-host driver iterates specs,
+        # never the raw count.
+        for spec in plan_ir.static_epoch_specs(filenames, num_epochs,
+                                               start_epoch):
+            epoch_idx = spec.epoch
             throttle_start = timeit.default_timer()
             # Budget pressure without a spill tier drains older epochs
             # before launching (single-host driver parity); with spilling

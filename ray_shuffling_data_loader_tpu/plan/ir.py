@@ -40,6 +40,7 @@ pyarrow.
 from __future__ import annotations
 
 import dataclasses
+import itertools
 import json
 from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
 
@@ -198,7 +199,14 @@ class EpochPlan:
     Node order is deterministic (maps by file index, reduces by reducer
     index, routes by rank), so two plans built from the same inputs
     serialize byte-identically — the property the checkpoint journal and
-    ``tools/rsdl_plan.py`` diffing rely on."""
+    ``tools/rsdl_plan.py`` diffing rely on.
+
+    ``window`` is the streaming provenance block (``streaming/window.py``):
+    a closed window compiles to a normal epoch plan and stamps
+    ``{"index", "policy", "ingest_watermark", "late_events"}`` here so
+    recovery and tools can see which stream window an epoch came from.
+    ``None`` (the static-file-list case) serializes to nothing — plans
+    from the pre-streaming world stay byte-identical."""
 
     seed: int
     epoch: int
@@ -207,6 +215,7 @@ class EpochPlan:
     filenames: List[str]
     nodes: Dict[str, PlanNode] = dataclasses.field(default_factory=dict)
     version: int = PLAN_VERSION
+    window: Optional[Dict[str, Any]] = None
 
     # -- queries --------------------------------------------------------
 
@@ -264,6 +273,15 @@ class EpochPlan:
                 f"plan version {self.version} != {PLAN_VERSION}")
         if self.num_reducers < 1 or self.num_trainers < 1:
             raise PlanError("num_reducers and num_trainers must be >= 1")
+        if self.window is not None:
+            if not isinstance(self.window, dict):
+                raise PlanError("window metadata must be a dict")
+            try:
+                if int(self.window["index"]) < 0:
+                    raise PlanError("window index must be >= 0")
+            except (KeyError, TypeError, ValueError) as e:
+                raise PlanError(
+                    f"malformed window metadata {self.window!r}: {e}") from e
         maps, reduces, routes = [], [], []
         for nid, node in self.nodes.items():
             if node.id != nid:
@@ -336,7 +354,7 @@ class EpochPlan:
     # -- serialization --------------------------------------------------
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d: Dict[str, Any] = {
             "version": self.version,
             "seed": self.seed,
             "epoch": self.epoch,
@@ -345,6 +363,11 @@ class EpochPlan:
             "filenames": list(self.filenames),
             "nodes": [n.as_dict() for n in self.nodes.values()],
         }
+        if self.window is not None:
+            # After "nodes" on purpose: absent for static plans, so the
+            # pre-streaming serialization stays byte-identical.
+            d["window"] = dict(sorted(self.window.items()))
+        return d
 
     def to_json(self, indent: Optional[int] = None) -> str:
         """Stable serialization: fixed top-level key order, nodes in
@@ -355,11 +378,13 @@ class EpochPlan:
     @classmethod
     def from_dict(cls, data: Mapping[str, Any]) -> "EpochPlan":
         try:
+            window = data.get("window")
             plan = cls(seed=int(data["seed"]), epoch=int(data["epoch"]),
                        num_reducers=int(data["num_reducers"]),
                        num_trainers=int(data["num_trainers"]),
                        filenames=[str(f) for f in data["filenames"]],
-                       version=int(data.get("version", PLAN_VERSION)))
+                       version=int(data.get("version", PLAN_VERSION)),
+                       window=dict(window) if window is not None else None)
         except (KeyError, TypeError, ValueError) as e:
             raise PlanError(f"malformed plan: {e}") from e
         for node_data in data.get("nodes", ()):
@@ -381,15 +406,17 @@ def from_json(text: str) -> EpochPlan:
 
 
 def build_epoch_plan(filenames: Iterable[str], num_reducers: int,
-                     num_trainers: int, seed: int,
-                     epoch: int) -> EpochPlan:
+                     num_trainers: int, seed: int, epoch: int,
+                     window: Optional[Dict[str, Any]] = None) -> EpochPlan:
     """Build (and validate) the canonical plan of one epoch:
     one map node per file, one reduce node per reducer (depending on
     every map), one route node per trainer rank consuming its contiguous
-    reducer span and naming its queue index."""
+    reducer span and naming its queue index. ``window`` stamps streaming
+    provenance onto the plan (closed-window epochs)."""
     plan = EpochPlan(seed=seed, epoch=epoch, num_reducers=num_reducers,
                      num_trainers=num_trainers,
-                     filenames=[str(f) for f in filenames])
+                     filenames=[str(f) for f in filenames],
+                     window=dict(window) if window is not None else None)
     map_ids = []
     for file_index, filename in enumerate(plan.filenames):
         nid = node_id("map", epoch, file_index)
@@ -416,6 +443,51 @@ def build_epoch_plan(filenames: Iterable[str], num_reducers: int,
                   "reducers": list(range(start, stop))})
     plan.validate()
     return plan
+
+
+# ---------------------------------------------------------------------------
+# Epoch specs: what the generalized shuffle driver iterates
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class EpochSpec:
+    """One epoch's worth of work, as the shuffle driver sees it BEFORE a
+    plan is built: the epoch index, the files it shuffles, and optional
+    streaming window provenance (stamped onto the built plan).
+
+    The driver loop in ``shuffle.py`` consumes an *iterator* of these —
+    the static file list compiles to :func:`static_epoch_specs`, a
+    stream's window assembler yields them unboundedly as windows close.
+    The ``static-epoch-assumption`` rsdl-lint rule pins the inversion:
+    library code no longer counts epochs with ``range(num_epochs)``;
+    epochs arrive from here."""
+
+    epoch: int
+    filenames: Tuple[str, ...]
+    window: Optional[Dict[str, Any]] = None
+
+
+def static_epoch_specs(filenames: Iterable[str], num_epochs: int,
+                       start_epoch: int = 0) -> Iterable[EpochSpec]:
+    """The classic epochs-over-a-fixed-file-list schedule as an epoch-spec
+    iterator: every epoch reshuffles the same files, ``start_epoch``
+    resumes mid-trial. THE one place the per-trial epoch range is
+    enumerated (shuffle.py consumes the iterator, never the count)."""
+    files = tuple(str(f) for f in filenames)
+    for epoch in range(start_epoch, num_epochs):
+        yield EpochSpec(epoch=epoch, filenames=files)
+
+
+def epoch_range(start_epoch: int, num_epochs: Optional[int]):
+    """Epoch indices for a consumer: ``range`` for a bounded trial,
+    ``itertools.count`` when ``num_epochs`` is None (an unbounded stream
+    — epochs keep arriving as windows close). Consumers iterate this
+    instead of hand-rolling ``range(num_epochs)``; the
+    ``static-epoch-assumption`` lint rule enforces it."""
+    if num_epochs is None:
+        return itertools.count(start_epoch)
+    return range(start_epoch, num_epochs)
 
 
 # ---------------------------------------------------------------------------

@@ -467,11 +467,42 @@ class CacheThrashDetector(Detector):
         return None
 
 
+class WatermarkLagDetector(Detector):
+    """Streaming ingest running away from serving.
+
+    ``rsdl_stream_watermark_lag_seconds`` (streaming/runner.py) is the
+    ingest watermark minus the serve watermark, in STREAM seconds: how
+    much sealed-but-unserved input exists. A bounded lag is the normal
+    pipelining depth (`max_concurrent_epochs` windows in flight); a lag
+    above ``slo_watermark_lag_s`` means windows close faster than the
+    shuffle+serving plane drains them — online training is falling
+    behind the stream and model freshness is decaying."""
+
+    name = "watermark_lag"
+
+    def __init__(self, component: str = "health", **overrides: Any):
+        super().__init__(component, **overrides)
+        self.lag_s = self._resolve("slo_watermark_lag_s")
+
+    def evaluate(self, ring: rt_history.HistoryRing) -> Optional[Breach]:
+        pts = ring.series("rsdl_stream_watermark_lag_seconds")
+        if not pts:
+            return None
+        lag = pts[-1][1]
+        if lag > self.lag_s:
+            return self._breach(
+                lag, self.lag_s,
+                f"stream serving lags ingest by {lag:.1f}s of stream "
+                f"time (budget {self.lag_s:.0f}s)")
+        return None
+
+
 _DETECTOR_TYPES: Dict[str, type] = {
     cls.name: cls for cls in (
         ThroughputDroopDetector, StallBreachDetector, LedgerCreepDetector,
         QueueSaturationDetector, LeaseChurnDetector, StragglerDriftDetector,
-        DeliveryLatencyDetector, FreshnessStallDetector, CacheThrashDetector)
+        DeliveryLatencyDetector, FreshnessStallDetector, CacheThrashDetector,
+        WatermarkLagDetector)
 }
 
 

@@ -90,6 +90,18 @@ METRIC_NAMES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "rsdl_storage_prefetch_issued_total": ("counter", ()),
     "rsdl_storage_prefetch_hits_total": ("counter", ()),
     "rsdl_storage_prefetch_canceled_total": ("counter", ()),
+    # -- streaming plane (streaming/: windowed shuffle over unbounded
+    #    input; watermarks are STREAM time — the newest admitted event's
+    #    timestamp — not wall clock) --
+    "rsdl_stream_window": ("gauge", ()),
+    "rsdl_stream_windows_closed_total": ("counter", ()),
+    "rsdl_stream_events_admitted_total": ("counter", ()),
+    "rsdl_stream_rows_ingested_total": ("counter", ()),
+    "rsdl_stream_late_events_total": ("counter", ("policy",)),
+    "rsdl_stream_ingest_watermark": ("gauge", ()),
+    "rsdl_stream_serve_watermark": ("gauge", ()),
+    "rsdl_stream_watermark_lag_seconds": ("gauge", ()),
+    "rsdl_stream_window_close_seconds": ("histogram", ()),
     # -- ops plane: history / health / incidents (runtime/{history,health}) --
     "rsdl_process_rss_bytes": ("gauge", ()),
     "rsdl_ledger_bytes_in_use": ("gauge", ()),

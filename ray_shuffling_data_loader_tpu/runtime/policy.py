@@ -289,6 +289,25 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     # tier smaller than the working set re-fetching every epoch.
     "slo_cache_evictions_per_min": (120.0, float),
     "slo_cache_hit_pct": (10.0, float),
+    # Streaming windows (streaming/window.py, RSDL_STREAM_WINDOW_*): a
+    # window seals at the FIRST bound hit — admitted file count, admitted
+    # payload bytes, or stream-time age since the window's first event
+    # (the watermark bound). 0 disables a bound (file count falls back
+    # to 1 if every bound is disabled: a window must be closable). Late
+    # arrivals — events whose stream timestamp precedes the journaled
+    # ingest watermark — follow window_late_policy: "admit" rolls them
+    # into the NEXT window (bounded disorder, nothing lost), "quarantine"
+    # excludes them into a structured report (the on_bad_file idiom).
+    "window_max_files": (4, int),
+    "window_max_bytes": (0, int),
+    "window_max_wait_s": (0.0, float),
+    "window_late_policy": ("admit", str),
+    # watermark_lag detector (runtime/health.py): how far the serve
+    # watermark (stream time fully drained to trainers) may trail the
+    # ingest watermark (stream time sealed into closed windows) before
+    # the stream is declared stale — the streaming analog of
+    # slo_freshness_s, measured in seconds of stream time.
+    "slo_watermark_lag_s": (300.0, float),
 }
 
 _lock = threading.Lock()

@@ -1186,7 +1186,8 @@ def shuffle_epoch(epoch: int,
                   spill_manager=None,
                   gather_threads: Optional[int] = None,
                   on_bad_file: str = "raise",
-                  fault_policies: Optional[Dict[str, Any]] = None
+                  fault_policies: Optional[Dict[str, Any]] = None,
+                  window: Optional[Dict[str, Any]] = None
                   ) -> List[ex.TaskRef]:
     """Launch one epoch's map/reduce and route outputs to trainers
     (reference: shuffle.py:163-196). Returns the reducer TaskRefs.
@@ -1207,7 +1208,7 @@ def shuffle_epoch(epoch: int,
     if stats_collector is not None:
         stats_collector.epoch_start(epoch)
     plan = plan_ir.build_epoch_plan(filenames, num_reducers, num_trainers,
-                                    seed, epoch)
+                                    seed, epoch, window=window)
     if getattr(pool, "backend", "thread") == "process":
         reduce_refs = _shuffle_epoch_process(
             plan, pool, stats_collector, map_transform, reduce_transform,
@@ -1413,6 +1414,7 @@ def shuffle(filenames: Sequence[str],
     Returns ``TrialStats`` when ``collect_stats`` else the wall-clock
     duration in seconds (reference: shuffle.py:155-160).
     """
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
     if not 0 <= start_epoch <= num_epochs:
         raise ValueError(
             f"start_epoch {start_epoch} out of range [0, {num_epochs}]")
@@ -1426,6 +1428,60 @@ def shuffle(filenames: Sequence[str],
             num_epochs, num_maps=len(filenames), num_reduces=num_reducers,
             num_consumes=num_trainers)
         stats_collector.trial_start()
+    duration = shuffle_epochs(
+        plan_ir.static_epoch_specs(filenames, num_epochs, start_epoch),
+        batch_consumer, num_reducers, num_trainers,
+        max_concurrent_epochs=max_concurrent_epochs, seed=seed,
+        num_workers=num_workers, pool=pool,
+        stats_collector=stats_collector, map_transform=map_transform,
+        file_cache=file_cache, reduce_transform=reduce_transform,
+        task_retries=task_retries, max_inflight_bytes=max_inflight_bytes,
+        spill_dir=spill_dir, on_bad_file=on_bad_file,
+        executor_backend=executor_backend,
+        epochs_hint=num_epochs - start_epoch)
+    if stats_collector is not None:
+        stats_collector.trial_done()
+        return stats_collector.get_stats()
+    return duration
+
+
+def shuffle_epochs(epoch_specs,
+                   batch_consumer: BatchConsumer,
+                   num_reducers: int,
+                   num_trainers: int,
+                   max_concurrent_epochs: int = 2,
+                   seed: int = 0,
+                   num_workers: Optional[int] = None,
+                   pool: Optional[ex.Executor] = None,
+                   stats_collector=None,
+                   map_transform: Optional[MapTransform] = None,
+                   file_cache: Union[FileTableCache, None, str] = "auto",
+                   reduce_transform: Optional[ReduceTransform] = None,
+                   task_retries: int = 0,
+                   max_inflight_bytes: Optional[int] = None,
+                   spill_dir: Optional[str] = None,
+                   on_bad_file: Optional[str] = None,
+                   executor_backend: Optional[str] = None,
+                   epochs_hint: Optional[int] = None,
+                   on_epoch_done: Optional[Callable[[int], None]] = None
+                   ) -> float:
+    """The generalized pipelined driver: shuffle every epoch an
+    *iterator* of :class:`plan.ir.EpochSpec` yields, keeping at most
+    ``max_concurrent_epochs`` in flight.
+
+    This is :func:`shuffle` with the epoch schedule inverted out: the
+    static trial passes :func:`plan.ir.static_epoch_specs`; a streaming
+    window assembler (``streaming/window.py``) yields specs unboundedly
+    as windows close, and may BLOCK in ``__next__`` waiting for input —
+    the pipeline then idles with all launched epochs still draining.
+
+    ``epochs_hint`` sizes the decoded-file cache and the gather-thread
+    overlap for finite schedules; ``None`` (unbounded stream, every file
+    shuffled exactly once) disables the cache and sizes overlap at the
+    concurrency cap. ``on_epoch_done(epoch)`` fires after an epoch's
+    reducer refs fully drain — the streaming runner's serve-watermark
+    hook. Returns the wall-clock duration in seconds.
+    """
     # Causal-trace context: every id this run's spans carry derives from
     # (seed, epoch, task); stamping the seed puts it into recorder dumps
     # so offline merges re-derive the same ids (runtime/trace.py).
@@ -1458,9 +1514,11 @@ def shuffle(filenames: Sequence[str],
         file_cache, owns_file_cache = None, False
         budget_cache = pool
     else:
-        # Caching only pays when a file is mapped more than once.
+        # Caching only pays when a file is mapped more than once. An
+        # unbounded stream (epochs_hint None) maps each window's files
+        # exactly once, so it resolves as a single-pass trial.
         file_cache, owns_file_cache = resolve_file_cache(
-            file_cache, num_epochs - start_epoch)
+            file_cache, epochs_hint if epochs_hint is not None else 1)
         budget_cache = file_cache
         if hasattr(file_cache, "set_transform"):
             # The cache stores TRANSFORMED tables (the map stage puts
@@ -1472,8 +1530,10 @@ def shuffle(filenames: Sequence[str],
         budget_cache, max_inflight_bytes, spill_dir)
     # Epoch pipelining keeps up to max_concurrent_epochs epochs' reduce
     # tasks in flight on this one pool — size gather threads for that
-    # total, not one epoch's worth (but no more epochs than actually run).
-    overlap = max(1, min(max_concurrent_epochs, num_epochs - start_epoch))
+    # total, not one epoch's worth (but no more epochs than actually run;
+    # an unbounded stream saturates the concurrency cap).
+    overlap = max(1, max_concurrent_epochs) if epochs_hint is None \
+        else max(1, min(max_concurrent_epochs, epochs_hint))
     gather_threads = derive_gather_threads(
         num_reducers * overlap, pool.num_workers)
     from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
@@ -1483,7 +1543,8 @@ def shuffle(filenames: Sequence[str],
 
     try:
         in_progress: Dict[int, List[ex.TaskRef]] = {}
-        for epoch_idx in range(start_epoch, num_epochs):
+        for spec in epoch_specs:
+            epoch_idx = spec.epoch
             throttle_start = timeit.default_timer()
             while in_progress and (len(in_progress) >= max_concurrent_epochs
                                    or _over_budget()):
@@ -1497,6 +1558,8 @@ def shuffle(filenames: Sequence[str],
                 # frame's loop variables would otherwise pin the drained
                 # epoch's last reducer table through the budget wait below.
                 refs = ref = None
+                if on_epoch_done is not None:
+                    on_epoch_done(oldest_epoch)
             if _over_budget() and spill_manager is None:
                 # All prior epochs drained; wait for consumers to release
                 # tables (bounded — never deadlock the pipeline on a
@@ -1526,10 +1589,11 @@ def shuffle(filenames: Sequence[str],
                 logger.info("epoch %d throttled for %.3fs", epoch_idx,
                             throttle_duration)
             in_progress[epoch_idx] = shuffle_epoch(
-                epoch_idx, filenames, batch_consumer, num_reducers,
+                epoch_idx, spec.filenames, batch_consumer, num_reducers,
                 num_trainers, pool, seed, start, stats_collector,
                 map_transform, file_cache, reduce_transform, spill_manager,
-                gather_threads, on_bad_file, fault_policies)
+                gather_threads, on_bad_file, fault_policies,
+                window=spec.window)
         # Final drain: wait for all remaining reducer tasks
         # (reference: shuffle.py:148-151).
         for epoch_idx in sorted(in_progress):
@@ -1537,6 +1601,9 @@ def shuffle(filenames: Sequence[str],
             ex.wait(refs, num_returns=len(refs))
             for ref in refs:
                 ref.result()  # propagate map/reduce failures (instant)
+            refs = ref = None
+            if on_epoch_done is not None:
+                on_epoch_done(epoch_idx)
     finally:
         if owns_pool:
             pool.shutdown()
@@ -1558,9 +1625,6 @@ def shuffle(filenames: Sequence[str],
             from ray_shuffling_data_loader_tpu import native
             native.trim_freelist()
 
-    if stats_collector is not None:
-        stats_collector.trial_done()
-        return stats_collector.get_stats()
     return timeit.default_timer() - start
 
 
@@ -1683,6 +1747,53 @@ def run_shuffle_in_background(
                            task_retries=task_retries,
                            max_inflight_bytes=max_inflight_bytes,
                            spill_dir=spill_dir, on_bad_file=on_bad_file)
+        except BaseException as e:  # noqa: BLE001 - forwarded to consumers
+            if on_failure is not None:
+                try:
+                    on_failure(e)
+                except Exception:  # noqa: BLE001
+                    logger.exception("shuffle on_failure hook itself failed")
+            raise
+        finally:
+            driver_pool.shutdown(wait_for_tasks=False)
+
+    return driver_pool.submit(_run)
+
+
+def run_shuffle_epochs_in_background(
+        epoch_specs,
+        batch_consumer: BatchConsumer,
+        num_reducers: int,
+        num_trainers: int,
+        max_concurrent_epochs: int = 2,
+        seed: int = 0,
+        num_workers: Optional[int] = None,
+        file_cache: Union[FileTableCache, None, str] = "auto",
+        task_retries: int = 0,
+        max_inflight_bytes: Optional[int] = None,
+        spill_dir: Optional[str] = None,
+        on_bad_file: Optional[str] = None,
+        epochs_hint: Optional[int] = None,
+        on_epoch_done: Optional[Callable[[int], None]] = None,
+        on_failure: Optional[Callable[[BaseException], None]] = None
+        ) -> ex.TaskRef:
+    """:func:`run_shuffle_in_background` for an epoch-spec schedule: the
+    driver loop consumes ``epoch_specs`` (an iterable/iterator of
+    :class:`plan.ir.EpochSpec` — a streaming window schedule, possibly
+    unbounded) on a dedicated single-worker executor. Same ``on_failure``
+    poison-pill contract as the static launcher."""
+    driver_pool = ex.Executor(num_workers=1, thread_name_prefix="rsdl-driver")
+
+    def _run():
+        try:
+            return shuffle_epochs(
+                epoch_specs, batch_consumer, num_reducers, num_trainers,
+                max_concurrent_epochs=max_concurrent_epochs, seed=seed,
+                num_workers=num_workers, file_cache=file_cache,
+                task_retries=task_retries,
+                max_inflight_bytes=max_inflight_bytes, spill_dir=spill_dir,
+                on_bad_file=on_bad_file, epochs_hint=epochs_hint,
+                on_epoch_done=on_epoch_done)
         except BaseException as e:  # noqa: BLE001 - forwarded to consumers
             if on_failure is not None:
                 try:

@@ -251,6 +251,10 @@ class TrialStatsCollector:
         self._num_epochs = num_epochs
         self._epochs = [
             EpochStatsCollector(num_maps, num_reduces, num_consumes)
+            # The caller DECLARED this finite count (stats collection is
+            # per-bounded-trial by contract; streaming runs pass no
+            # collector), so pre-sizing is the static shape, not an
+            # assumption: rsdl-lint: disable=static-epoch-assumption
             for _ in range(num_epochs)
         ]
         self._trial_start_time: Optional[float] = None

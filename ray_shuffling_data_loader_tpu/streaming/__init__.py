@@ -1,0 +1,41 @@
+"""streaming/: continuous ingestion and windowed shuffle over unbounded
+input.
+
+Everything built through PR 14 is epoch-over-static-file-list: one job,
+one fixed set of Parquet files, epochs counted up front. This package
+spends the substrate those PRs laid down — absolute ``row_offset``
+accounting, delivered-watermark journals and exactly-once replay (PR 5),
+the epoch-plan IR (PR 9), the sharded serving plane (PR 10) and the
+storage plane (PR 14) — on the last missing production scenario:
+unbounded input, watermark-driven epoch boundaries, and online training
+on fresh data.
+
+The design is deliberately thin: a **window is just an epoch**. Events
+(arriving files) accumulate into a window (``window.py``); a closed
+window compiles to a normal :class:`plan.ir.EpochPlan` with streaming
+provenance stamped on it — so the scheduler, speculation, chaos,
+lineage recovery, sharded serving, tiered cache and prefetch all apply
+unchanged, and the PR 5 exactly-once matrix covers window boundaries
+for free (the resume math is epoch-generic).
+
+- :mod:`streaming.source` — where events come from: the
+  :class:`StreamSource` contract, a manifest-journaled
+  :class:`DirectoryTailSource`, and the hermetic seeded
+  :class:`SyntheticEventSource`.
+- :mod:`streaming.window` — window policies (count / byte / watermark
+  bounds, ``RSDL_STREAM_WINDOW_*``), late-arrival handling
+  (admit-to-next-window | quarantine), the journaled monotone ingest
+  watermark, and compilation of closed windows to epoch specs.
+- :mod:`streaming.runner` — :class:`StreamingShuffleRunner`: pipelines
+  window N+1 assembly/shuffle under window N serving (the
+  ``max_concurrent_epochs`` throttle, unchanged), plus the frozen-
+  schedule config handed to supervised queue-server processes.
+"""
+
+from ray_shuffling_data_loader_tpu.streaming.source import (  # noqa: F401
+    DirectoryTailSource, StreamEvent, StreamSource, SyntheticEventSource)
+from ray_shuffling_data_loader_tpu.streaming.window import (  # noqa: F401
+    Window, WindowAssembler, WindowPolicy, freeze_schedule,
+    specs_from_dicts, specs_to_dicts)
+from ray_shuffling_data_loader_tpu.streaming.runner import (  # noqa: F401
+    StreamingShuffleRunner)

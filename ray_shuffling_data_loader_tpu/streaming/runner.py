@@ -1,0 +1,261 @@
+"""The streaming driver: windows in, pipelined epochs out.
+
+:class:`StreamingShuffleRunner` wires a :class:`streaming.source` into
+the generalized shuffle driver (:func:`shuffle.shuffle_epochs`): window
+N+1 assembles and shuffles WHILE window N serves — that is just the
+``max_concurrent_epochs`` throttle doing what it always did, because a
+window is an epoch. The runner adds the streaming bookkeeping the
+static driver never needed:
+
+- the **serve watermark** (stream time fully handed to the serving
+  plane) advanced from the driver's ``on_epoch_done`` hook, and the
+  ``rsdl_stream_watermark_lag_seconds`` gauge the ``watermark_lag``
+  health detector watches;
+- the ingest journal (``checkpoint.StreamJournal``) threaded through
+  the assembler so a restarted run resumes window/epoch numbering and
+  skips the already-sealed event prefix;
+- :meth:`server_config` — the frozen window schedule a supervised
+  queue-server child (``multiqueue_service.serve_pipeline``) re-derives
+  identically on every ``kill -9`` restart, which is what carries the
+  PR 5 exactly-once matrix across window boundaries.
+
+Online training consumes the served stream exactly like epoch training
+does (``JaxShufflingDataset`` in unbounded mode, or a remote queue
+client) — the trainer's checkpoint/ack machinery needs no streaming
+awareness at all.
+"""
+
+from __future__ import annotations
+
+import importlib
+import timeit
+from typing import Any, Callable, Dict, Optional
+
+from ray_shuffling_data_loader_tpu import executor as ex
+from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+from ray_shuffling_data_loader_tpu.streaming import window as win
+from ray_shuffling_data_loader_tpu.streaming.source import StreamSource
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+# Lazy: shuffle.py imports numpy/pyarrow; keep the module importable in
+# tool contexts that only need server_config plumbing.
+_sh = None
+
+
+def _shuffle_mod():
+    global _sh
+    if _sh is None:
+        _sh = importlib.import_module(
+            "ray_shuffling_data_loader_tpu.shuffle")
+    return _sh
+
+
+logger = setup_custom_logger(__name__)
+
+
+class StreamingShuffleRunner:
+    """Drive an unbounded (or bounded) stream through the shuffle.
+
+    ``batch_consumer`` has the exact static-driver contract
+    (``batch_consumer(rank, epoch, refs_or_None)``); epoch indices are
+    ``first_epoch + window_index``, so a consumer reading queue
+    ``plan.ir.queue_index(epoch, rank, num_trainers)`` works unchanged.
+
+    ``journal_path`` enables ingest journaling AND recovery: a runner
+    constructed over the same journal resumes at the next unsealed
+    window, skipping the source's already-sealed event prefix (the
+    source re-yields the identical sequence — the manifest/seed
+    contract), so the resumed run's epochs continue the original
+    numbering with zero events missed or re-sealed."""
+
+    def __init__(self, source: StreamSource,
+                 batch_consumer,
+                 num_reducers: int,
+                 num_trainers: int,
+                 seed: int = 0,
+                 max_concurrent_epochs: int = 2,
+                 policy: Optional[win.WindowPolicy] = None,
+                 journal_path: Optional[str] = None,
+                 first_epoch: int = 0,
+                 num_workers: Optional[int] = None,
+                 max_windows: Optional[int] = None,
+                 clock_step_s: Optional[float] = None,
+                 on_window_served: Optional[Callable[[int], None]] = None):
+        from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+        self.source = source
+        self.batch_consumer = batch_consumer
+        self.num_reducers = num_reducers
+        self.num_trainers = num_trainers
+        self.seed = seed
+        self.max_concurrent_epochs = max_concurrent_epochs
+        self.num_workers = num_workers
+        self.max_windows = max_windows
+        self.clock_step_s = clock_step_s
+        self._on_window_served = on_window_served
+        journal = None
+        resumed = {"next_window": 0, "events_sealed": 0,
+                   "ingest_watermark": float("-inf")}
+        if journal_path:
+            resumed = win.resume_state(journal_path)
+            journal = ckpt.StreamJournal(journal_path)
+        self.resume_skip_events = resumed["events_sealed"]
+        self.assembler = win.WindowAssembler(
+            policy=policy, journal=journal, first_epoch=first_epoch,
+            first_window=resumed["next_window"])
+        if resumed["ingest_watermark"] != float("-inf"):
+            self.assembler.ingest_watermark = resumed["ingest_watermark"]
+        self.serve_watermark = float("-inf")
+        self._window_meta: Dict[int, Dict[str, Any]] = {}
+        self.windows_served = 0
+        self._gauge_serve = rt_metrics.gauge(
+            "rsdl_stream_serve_watermark",
+            "stream time fully handed to the serving plane")
+        self._gauge_lag = rt_metrics.gauge(
+            "rsdl_stream_watermark_lag_seconds",
+            "ingest watermark minus serve watermark, stream seconds")
+
+    # -- watermark bookkeeping -----------------------------------------
+
+    def _observe_lag(self) -> None:
+        ingest = self.assembler.ingest_watermark
+        serve = self.serve_watermark
+        if ingest == float("-inf"):
+            return
+        lag = 0.0 if serve == float("-inf") else max(0.0, ingest - serve)
+        if serve == float("-inf"):
+            # Nothing served yet: everything sealed is lag.
+            lag = max(0.0, ingest - min(
+                m["ingest_watermark"] for m in self._window_meta.values()
+            )) if self._window_meta else 0.0
+        self._gauge_lag.set(lag)
+
+    def _on_epoch_done(self, epoch: int) -> None:
+        meta = self._window_meta.pop(epoch, None)
+        if meta is None:
+            return
+        self.windows_served += 1
+        watermark = meta.get("ingest_watermark")
+        if watermark is not None:
+            self.serve_watermark = max(self.serve_watermark,
+                                       float(watermark))
+            self._gauge_serve.set(self.serve_watermark)
+        self._observe_lag()
+        if self._on_window_served is not None:
+            self._on_window_served(int(meta["index"]))
+
+    def _specs(self):
+        skip = self.resume_skip_events
+        for spec in self.assembler.specs(self.source,
+                                         max_windows=self.max_windows,
+                                         clock_step_s=self.clock_step_s):
+            if spec.window is not None:
+                self._window_meta[spec.epoch] = dict(spec.window)
+            self._observe_lag()
+            yield spec
+        if skip:
+            # Diagnostics only (the skip itself happened in admit order).
+            logger.info("stream resume: %d already-sealed events were "
+                        "skipped before window %d", skip,
+                        self.assembler.window_index)
+
+    def _skip_sealed_prefix(self) -> None:
+        """Drop the source's first ``resume_skip_events`` events — the
+        prefix the journal says is already inside sealed windows. The
+        source re-yields the identical sequence (manifest/seed
+        determinism), so dropping by count is dropping by identity."""
+        remaining = self.resume_skip_events
+        while remaining > 0:
+            events = self.source.poll()
+            if not events:
+                if self.source.exhausted:
+                    break
+                continue
+            if len(events) > remaining:
+                # Partial batch: re-admit the tail through the assembler.
+                for event in events[remaining:]:
+                    self.assembler.admit(event)
+                remaining = 0
+                break
+            remaining -= len(events)
+
+    # -- driving -------------------------------------------------------
+
+    def run(self) -> Dict[str, Any]:
+        """Run the stream to completion (bounded source or
+        ``max_windows``) and return a summary dict. Unbounded streams
+        run until the source exhausts — callers wanting detachment use
+        :meth:`run_in_background`."""
+        sh = _shuffle_mod()
+        start = timeit.default_timer()
+        self._skip_sealed_prefix()
+        duration = sh.shuffle_epochs(
+            self._specs(), self.batch_consumer, self.num_reducers,
+            self.num_trainers,
+            max_concurrent_epochs=self.max_concurrent_epochs,
+            seed=self.seed, num_workers=self.num_workers,
+            file_cache=None, epochs_hint=None,
+            on_epoch_done=self._on_epoch_done)
+        return {
+            "duration_s": timeit.default_timer() - start,
+            "shuffle_s": duration,
+            "windows_closed": self.assembler.window_index,
+            "windows_served": self.windows_served,
+            "events_sealed": self.assembler.events_sealed,
+            "late_events": self.assembler.late_events,
+            "quarantined": len(self.assembler.quarantined),
+            "ingest_watermark": self.assembler.ingest_watermark,
+            "serve_watermark": self.serve_watermark,
+        }
+
+    def run_in_background(self) -> ex.TaskRef:
+        """The :func:`shuffle.run_shuffle_in_background` idiom: the
+        whole streaming drive on a dedicated single-worker executor."""
+        driver_pool = ex.Executor(num_workers=1,
+                                  thread_name_prefix="rsdl-stream")
+
+        def _run():
+            try:
+                return self.run()
+            finally:
+                driver_pool.shutdown(wait_for_tasks=False)
+
+        return driver_pool.submit(_run)
+
+    def close(self) -> None:
+        self.source.close()
+
+
+def server_config(source: StreamSource,
+                  num_trainers: int,
+                  num_reducers: int,
+                  journal_path: str,
+                  seed: int = 0,
+                  policy: Optional[win.WindowPolicy] = None,
+                  max_windows: Optional[int] = None,
+                  max_concurrent_epochs: int = 2,
+                  ingest_journal_path: Optional[str] = None,
+                  **extra: Any) -> Dict[str, Any]:
+    """Build the supervised queue-server config for a BOUNDED stream:
+    drain ``source`` into a frozen window schedule (journaling ingest
+    watermarks when ``ingest_journal_path`` is given) and emit the
+    ``multiqueue_service.serve_pipeline`` config whose ``epochs`` block
+    carries it. The schedule is pure data, so every restarted
+    incarnation re-derives the identical epochs — the streaming leg of
+    the kill -9 matrix rides entirely on the PR 5 machinery."""
+    from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+    journal = (ckpt.StreamJournal(ingest_journal_path)
+               if ingest_journal_path else None)
+    specs = win.freeze_schedule(source, policy=policy,
+                                max_windows=max_windows, journal=journal)
+    if journal is not None:
+        journal.close()
+    config = {
+        "epochs": win.specs_to_dicts(specs),
+        "num_trainers": int(num_trainers),
+        "num_reducers": int(num_reducers),
+        "seed": int(seed),
+        "max_concurrent_epochs": int(max_concurrent_epochs),
+        "journal_path": journal_path,
+    }
+    config.update(extra)
+    return config

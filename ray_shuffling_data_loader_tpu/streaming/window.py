@@ -1,0 +1,345 @@
+"""Windowed epoch assembly: events accumulate, windows close, epochs run.
+
+The one design decision that makes streaming cheap here: **a window is
+just an epoch**. The :class:`WindowAssembler` admits events from a
+:class:`streaming.source.StreamSource`, seals a window when the first
+policy bound trips (file count, payload bytes, or stream-time age —
+``RSDL_STREAM_WINDOW_*``), and compiles each sealed window to a normal
+:class:`plan.ir.EpochSpec` whose plan carries streaming provenance
+(``EpochPlan.window``). Everything downstream — the plan scheduler,
+speculation, chaos, lineage recovery, sharded serving, tiered cache,
+prefetch, and the PR 5 exactly-once replay matrix — applies unchanged,
+because none of it ever cared where an epoch's file list came from.
+
+Watermarks: the **ingest watermark** is the maximum stream timestamp
+sealed into any closed window — monotone by construction, journaled
+durably (``checkpoint.StreamJournal``) beside the delivery watermarks
+so the two ends of the pipe are comparable. An event arriving with a
+timestamp *behind* the ingest watermark is **late**: under the
+``admit`` policy it rolls into the currently-open window (bounded
+disorder, nothing lost — the window boundary moved past it, the data
+did not); under ``quarantine`` it is excluded into a structured report
+(the ``on_bad_file`` idiom) and counted.
+
+Recovery: window assembly is deterministic in the admitted-event
+sequence. A recovered assembler replays the ingest journal to find how
+many events were already sealed (``resume_events``), skips exactly that
+prefix of the source's (identically re-yielded) event sequence, and
+continues — re-closing the same windows at the same boundaries.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import timeit
+from typing import Any, Dict, Iterator, List, Optional
+
+from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
+from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
+from ray_shuffling_data_loader_tpu.streaming.source import (StreamEvent,
+                                                            StreamSource)
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+#: ``window_late_policy`` vocabulary.
+LATE_POLICIES = ("admit", "quarantine")
+
+
+@dataclasses.dataclass(frozen=True)
+class WindowPolicy:
+    """When a window seals and what happens to late arrivals.
+
+    A window seals at the FIRST bound hit; a bound of 0 is disabled.
+    With every bound disabled ``max_files`` falls back to 1 — a window
+    must be closable or the stream would buffer forever."""
+
+    max_files: int = 4
+    max_bytes: int = 0
+    max_wait_s: float = 0.0
+    late_policy: str = "admit"
+
+    def __post_init__(self):
+        if self.late_policy not in LATE_POLICIES:
+            raise ValueError(
+                f"late_policy {self.late_policy!r} not in {LATE_POLICIES}")
+
+    @classmethod
+    def resolve(cls, max_files: Optional[int] = None,
+                max_bytes: Optional[int] = None,
+                max_wait_s: Optional[float] = None,
+                late_policy: Optional[str] = None) -> "WindowPolicy":
+        """Resolve through the policy registry (component ``stream``,
+        env ``RSDL_STREAM_WINDOW_*``); kwargs override."""
+        def res(key, override):
+            return rt_policy.resolve("stream", key, override=override)
+        max_files = int(res("window_max_files", max_files))
+        max_bytes = int(res("window_max_bytes", max_bytes))
+        max_wait_s = float(res("window_max_wait_s", max_wait_s))
+        if max_files <= 0 and max_bytes <= 0 and max_wait_s <= 0:
+            max_files = 1
+        return cls(max_files=max_files, max_bytes=max_bytes,
+                   max_wait_s=max_wait_s,
+                   late_policy=str(res("window_late_policy", late_policy)))
+
+    def as_dict(self) -> Dict[str, Any]:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Window:
+    """One sealed window: the events it admitted and its watermark."""
+
+    index: int
+    events: List[StreamEvent]
+    ingest_watermark: float
+    late_events: int = 0
+
+    @property
+    def filenames(self) -> List[str]:
+        return [e.path for e in self.events]
+
+    @property
+    def size_bytes(self) -> int:
+        return sum(e.size_bytes for e in self.events)
+
+    def meta(self, policy: WindowPolicy) -> Dict[str, Any]:
+        """The provenance block stamped onto the window's epoch plan."""
+        return {"index": self.index,
+                "events": [e.index for e in self.events],
+                "ingest_watermark": self.ingest_watermark,
+                "late_events": self.late_events,
+                "policy": policy.as_dict()}
+
+    def to_epoch_spec(self, epoch: int,
+                      policy: WindowPolicy) -> plan_ir.EpochSpec:
+        return plan_ir.EpochSpec(epoch=epoch,
+                                 filenames=tuple(self.filenames),
+                                 window=self.meta(policy))
+
+
+class WindowAssembler:
+    """Admit events, seal windows, journal the ingest watermark.
+
+    ``first_epoch`` maps window 0 to an epoch index (a resumed stream
+    continues the epoch numbering it left off at). ``journal`` is a
+    :class:`checkpoint.StreamJournal`; every sealed window appends one
+    durable watermark record, so :func:`resume_state` can tell a
+    restarted pipeline how many events are already inside sealed
+    windows and which window/epoch comes next."""
+
+    def __init__(self, policy: Optional[WindowPolicy] = None,
+                 journal=None, first_epoch: int = 0,
+                 first_window: int = 0):
+        self.policy = policy or WindowPolicy.resolve()
+        self._journal = journal
+        self._first_epoch = first_epoch
+        self._window_index = first_window
+        self._pending: List[StreamEvent] = []
+        self._pending_late = 0
+        self._opened_at: Optional[float] = None  # wall clock, close timing
+        self.ingest_watermark = float("-inf")
+        self.events_sealed = 0
+        self.quarantined: List[StreamEvent] = []
+        self._late_total = 0
+        self._gauge_window = rt_metrics.gauge(
+            "rsdl_stream_window", "index of the currently-open window")
+        self._gauge_ingest = rt_metrics.gauge(
+            "rsdl_stream_ingest_watermark",
+            "stream time sealed into closed windows")
+        self._counter_closed = rt_metrics.counter(
+            "rsdl_stream_windows_closed_total", "windows sealed")
+        self._counter_admitted = rt_metrics.counter(
+            "rsdl_stream_events_admitted_total",
+            "events admitted into windows")
+        self._hist_close = rt_metrics.histogram(
+            "rsdl_stream_window_close_seconds",
+            "wall time from a window's first event to its seal")
+
+    @property
+    def window_index(self) -> int:
+        """Index of the currently-open window."""
+        return self._window_index
+
+    @property
+    def next_epoch(self) -> int:
+        return self._first_epoch + self._window_index
+
+    @property
+    def pending_events(self) -> int:
+        return len(self._pending)
+
+    @property
+    def late_events(self) -> int:
+        """Late arrivals observed so far (both policies)."""
+        return self._late_total
+
+    def admit(self, event: StreamEvent) -> bool:
+        """Admit one event into the open window. Returns False when the
+        event was quarantined instead (late + ``quarantine`` policy)."""
+        late = event.timestamp < self.ingest_watermark
+        if late:
+            self._late_total += 1
+            rt_metrics.counter(
+                "rsdl_stream_late_events_total",
+                "events arriving behind the ingest watermark",
+                policy=self.policy.late_policy).inc()
+            rt_telemetry.record("stream_late_event", index=event.index,
+                                policy=self.policy.late_policy)
+            if self.policy.late_policy == "quarantine":
+                self.quarantined.append(event)
+                return False
+            self._pending_late += 1
+        if self._opened_at is None:
+            self._opened_at = timeit.default_timer()
+        self._pending.append(event)
+        self._counter_admitted.inc()
+        self._gauge_window.set(self._window_index)
+        return True
+
+    def should_close(self) -> bool:
+        if not self._pending:
+            return False
+        policy = self.policy
+        if policy.max_files > 0 and len(self._pending) >= policy.max_files:
+            return True
+        if policy.max_bytes > 0 and sum(
+                e.size_bytes for e in self._pending) >= policy.max_bytes:
+            return True
+        if policy.max_wait_s > 0:
+            newest = max(e.timestamp for e in self._pending)
+            oldest = min(e.timestamp for e in self._pending)
+            if newest - oldest >= policy.max_wait_s:
+                return True
+        return False
+
+    def close_window(self) -> Optional[Window]:
+        """Seal the open window (regardless of bounds — the force-close
+        path for stream end); None when nothing is pending."""
+        if not self._pending:
+            return None
+        events, self._pending = self._pending, []
+        late, self._pending_late = self._pending_late, 0
+        # Monotone: a window of purely-late admitted events cannot move
+        # the watermark backwards.
+        watermark = max(self.ingest_watermark,
+                        max(e.timestamp for e in events))
+        window = Window(index=self._window_index, events=events,
+                        ingest_watermark=watermark, late_events=late)
+        self.ingest_watermark = watermark
+        self.events_sealed += len(events)
+        self._window_index += 1
+        if self._opened_at is not None:
+            self._hist_close.observe(
+                timeit.default_timer() - self._opened_at)
+            self._opened_at = None
+        if self._journal is not None:
+            self._journal.append({
+                "kind": "watermark", "window": window.index,
+                "events": self.events_sealed,
+                "watermark": window.ingest_watermark,
+                "late": window.late_events,
+                "files": len(window.events)})
+        self._counter_closed.inc()
+        self._gauge_ingest.set(watermark)
+        rt_telemetry.record("stream_window_closed", window=window.index,
+                            files=len(window.events), late=late)
+        return window
+
+    def maybe_close(self) -> Optional[Window]:
+        return self.close_window() if self.should_close() else None
+
+    def specs(self, source: StreamSource,
+              max_windows: Optional[int] = None,
+              clock_step_s: Optional[float] = None,
+              poll_interval_s: float = 0.05
+              ) -> Iterator[plan_ir.EpochSpec]:
+        """THE window iterator: poll ``source``, admit, seal, yield one
+        :class:`plan.ir.EpochSpec` per sealed window — the iterator
+        :func:`shuffle.shuffle_epochs` drives. Ends when the source
+        exhausts (remainder force-closed) or after ``max_windows``.
+
+        ``clock_step_s`` advances a self-clocked source by that much
+        stream time per poll; ``None`` polls un-clocked (event-at-a-time
+        for synthetic sources, arrival-paced for directory tails).
+        ``poll_interval_s`` paces empty polls of a live source — this
+        generator legitimately BLOCKS between arrivals; the shuffle
+        pipeline behind it keeps draining launched epochs meanwhile."""
+        import time as _time
+        now = None
+        produced = 0
+        while max_windows is None or produced < max_windows:
+            if clock_step_s is not None:
+                now = clock_step_s if now is None else now + clock_step_s
+            events = source.poll(now)
+            for event in events:
+                self.admit(event)
+                window = self.maybe_close()
+                if window is not None:
+                    yield window.to_epoch_spec(
+                        self._first_epoch + window.index, self.policy)
+                    produced += 1
+                    if max_windows is not None and produced >= max_windows:
+                        return
+            if not events:
+                if source.exhausted:
+                    window = self.close_window()
+                    if window is not None:
+                        yield window.to_epoch_spec(
+                            self._first_epoch + window.index, self.policy)
+                    return
+                if clock_step_s is None and poll_interval_s > 0:
+                    _time.sleep(poll_interval_s)
+
+
+def resume_state(journal_path: str) -> Dict[str, Any]:
+    """What a restarted stream learns from its ingest journal:
+    ``next_window`` (first unsealed window index), ``events_sealed``
+    (events already inside sealed windows — the prefix of the source's
+    re-yielded sequence to skip), and the journaled monotone
+    ``ingest_watermark``."""
+    from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+    state = {"next_window": 0, "events_sealed": 0,
+             "ingest_watermark": float("-inf")}
+    for entry in ckpt.StreamJournal.load(journal_path):
+        if entry.get("kind") != "watermark":
+            continue
+        state["next_window"] = max(state["next_window"],
+                                   int(entry["window"]) + 1)
+        state["events_sealed"] = max(state["events_sealed"],
+                                     int(entry["events"]))
+        state["ingest_watermark"] = max(state["ingest_watermark"],
+                                        float(entry["watermark"]))
+    return state
+
+
+def freeze_schedule(source: StreamSource,
+                    policy: Optional[WindowPolicy] = None,
+                    max_windows: Optional[int] = None,
+                    first_epoch: int = 0,
+                    journal=None) -> List[plan_ir.EpochSpec]:
+    """Drain a bounded source into a frozen window schedule — the
+    explicit per-epoch file list a supervised queue-server child
+    (``multiqueue_service.serve_pipeline``, ``config["epochs"]``)
+    re-derives identically on every restart."""
+    assembler = WindowAssembler(policy=policy, journal=journal,
+                                first_epoch=first_epoch)
+    return list(assembler.specs(source, max_windows=max_windows))
+
+
+def specs_to_dicts(specs: List[plan_ir.EpochSpec]) -> List[Dict[str, Any]]:
+    """JSON-safe form of a frozen schedule (the supervised-child config
+    block)."""
+    return [{"epoch": s.epoch, "filenames": list(s.filenames),
+             "window": s.window} for s in specs]
+
+
+def specs_from_dicts(data) -> List[plan_ir.EpochSpec]:
+    return [plan_ir.EpochSpec(
+                epoch=int(d["epoch"]),
+                filenames=tuple(str(f) for f in d["filenames"]),
+                window=(dict(d["window"])
+                        if d.get("window") is not None else None))
+            for d in data]

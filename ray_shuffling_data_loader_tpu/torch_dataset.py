@@ -210,7 +210,8 @@ if __name__ == "__main__":
             feature_types=[torch.long] * len(feature_columns),
             label_column=dg.LABEL_COLUMN,
             label_type=torch.double)
-        for epoch in range(args.num_epochs):
+        from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+        for epoch in plan_ir.epoch_range(0, args.num_epochs):
             ds.set_epoch(epoch)
             rows = batches = 0
             for features, label in ds:

@@ -18,11 +18,22 @@ module wires that schema through the shuffle into the real DLRM model
   ``parallel.distributed.create_distributed_batch_queue_and_shuffle`` on
   each host — examples/jax_train_shuffle.py shows the full recipe
   (``RSDL_HOSTS`` global shuffle + per-host consumer queues).
+
+Online training (streaming/): click logs are the canonical UNBOUNDED
+input — the click-through rate drifts as campaigns rotate, and a model
+trained on a frozen snapshot decays. :func:`generate_drifting_stream`
+writes DLRM-schema files whose CTR drifts sinusoidally with stream
+position, and :func:`run_online_training` consumes them through a
+:class:`streaming.runner.StreamingShuffleRunner` — one closed window =
+one training epoch — updating an :class:`OnlineCTRModel` per window.
+The returned history shows the estimate tracking the drift, which a
+static-shuffle trainer structurally cannot do (examples/streaming.md).
 """
 
 from __future__ import annotations
 
-from typing import Any, Dict, List
+import math
+from typing import Any, Dict, List, Optional
 
 import numpy as np
 
@@ -58,3 +69,136 @@ def dlrm_spec() -> Dict[str, Any]:
         "label_column": dg.LABEL_COLUMN,
         "label_type": np.float32,
     }
+
+
+# ---------------------------------------------------------------------------
+# Drifting click stream: the online-training scenario
+# ---------------------------------------------------------------------------
+
+
+def drifting_ctr(file_index: int, drift_period: float = 8.0,
+                 base: float = 0.25, amplitude: float = 0.2) -> float:
+    """True click-through rate at stream position ``file_index`` — a slow
+    sinusoid (campaign rotation), the ground truth an online model must
+    track and a frozen model drifts away from."""
+    return base + amplitude * math.sin(
+        2.0 * math.pi * file_index / drift_period)
+
+
+def generate_drifting_click_file(file_index: int, num_rows: int,
+                                 data_dir: str, seed: int = 0,
+                                 drift_period: float = 8.0) -> str:
+    """One stream file of DLRM-schema rows whose labels are Bernoulli
+    draws at :func:`drifting_ctr`. Features reuse the reference
+    generator (same columns, same cardinalities); only the label
+    distribution moves. Deterministic in ``(seed, file_index)``."""
+    from ray_shuffling_data_loader_tpu.utils import fileio
+    table = dg.generate_row_group(0, file_index * num_rows, num_rows,
+                                  seed=seed)
+    ctr = drifting_ctr(file_index, drift_period)
+    rng = np.random.Generator(np.random.Philox(
+        np.random.SeedSequence([seed, file_index])))
+    labels = (rng.random(num_rows) < ctr).astype(np.float64)
+    table = table.set_column(table.schema.get_field_index(dg.LABEL_COLUMN),
+                             dg.LABEL_COLUMN, [labels])
+    filename = fileio.join(data_dir,
+                           f"clicks_{file_index:05d}.parquet.snappy")
+    fileio.write_parquet(table, filename, compression="snappy",
+                         row_group_size=num_rows)
+    return filename
+
+
+def generate_drifting_stream(num_files: int, rows_per_file: int,
+                             data_dir: str, seed: int = 0,
+                             drift_period: float = 8.0) -> List[str]:
+    """The whole drifting stream, in arrival order."""
+    from ray_shuffling_data_loader_tpu.utils import fileio
+    fileio.makedirs(data_dir)
+    return [generate_drifting_click_file(i, rows_per_file, data_dir,
+                                         seed=seed,
+                                         drift_period=drift_period)
+            for i in range(num_files)]
+
+
+class OnlineCTRModel:
+    """Bias-only logistic regression trained by online SGD.
+
+    The smallest model that exhibits the online-training property: its
+    single logit must keep MOVING to follow the label drift, so a run
+    over a drifting stream shows per-window estimates tracking
+    :func:`drifting_ctr` while any frozen estimate accumulates error.
+    (The full DLRM tower from models/dlrm.py plugs into the same loop —
+    this keeps the example hermetic and CPU-cheap.)"""
+
+    def __init__(self, lr: float = 0.5):
+        self.lr = float(lr)
+        self.logit = 0.0
+        self.steps = 0
+
+    def predict(self) -> float:
+        return 1.0 / (1.0 + math.exp(-self.logit))
+
+    def update(self, labels: np.ndarray) -> None:
+        """One SGD step on a batch: gradient of mean log-loss w.r.t. the
+        logit is ``predict() - mean(labels)``."""
+        if labels.size == 0:
+            return
+        self.logit += self.lr * (float(np.mean(labels)) - self.predict())
+        self.steps += 1
+
+
+def run_online_training(files: List[str], num_windows: int,
+                        files_per_window: int = 2, seed: int = 0,
+                        num_reducers: int = 2,
+                        journal_path: Optional[str] = None,
+                        lr: float = 0.5) -> List[Dict[str, Any]]:
+    """Online training over a drifting click stream, end to end.
+
+    Streams ``files`` through a seeded :class:`SyntheticEventSource`,
+    seals ``files_per_window``-file windows, shuffles each closed window
+    as a normal epoch, and runs one :class:`OnlineCTRModel` SGD pass per
+    delivered reducer table. Returns one record per window:
+    ``{"window", "observed_ctr", "estimate"}`` — ``estimate`` is the
+    model AFTER training on that window, ``observed_ctr`` the window's
+    empirical label mean. Deterministic in ``(files, seed)``."""
+    from ray_shuffling_data_loader_tpu import streaming as st
+    from ray_shuffling_data_loader_tpu.streaming import window as st_window
+
+    model = OnlineCTRModel(lr=lr)
+    per_epoch: Dict[int, Dict[str, float]] = {}
+    history: List[Dict[str, Any]] = []
+
+    def consumer(rank, epoch, refs):
+        if refs is None:
+            stats = per_epoch.pop(epoch, {"clicks": 0.0, "rows": 0.0})
+            rows = max(1.0, stats["rows"])
+            history.append({
+                "window": epoch,
+                "observed_ctr": stats["clicks"] / rows,
+                "estimate": model.predict(),
+            })
+            return
+        for ref in refs:
+            table = ref.result() if hasattr(ref, "result") else ref
+            labels = np.asarray(
+                table.column(dg.LABEL_COLUMN).combine_chunks())
+            model.update(labels)
+            stats = per_epoch.setdefault(epoch,
+                                         {"clicks": 0.0, "rows": 0.0})
+            stats["clicks"] += float(labels.sum())
+            stats["rows"] += float(labels.size)
+
+    source = st.SyntheticEventSource(
+        files, seed=seed, total_events=num_windows * files_per_window)
+    # max_concurrent_epochs=1: online SGD consumes windows in stream
+    # order — overlapping window N+1's shuffle under window N's training
+    # is a serving-plane optimization (the runner's default), but HERE
+    # the model update order must be the stream order to be meaningful.
+    runner = st.StreamingShuffleRunner(
+        source, consumer, num_reducers=num_reducers, num_trainers=1,
+        seed=seed, max_concurrent_epochs=1,
+        policy=st_window.WindowPolicy(max_files=files_per_window),
+        journal_path=journal_path)
+    runner.run()
+    history.sort(key=lambda rec: rec["window"])
+    return history

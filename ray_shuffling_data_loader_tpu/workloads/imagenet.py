@@ -231,7 +231,8 @@ if __name__ == "__main__":
 
         start = timeit.default_timer()
         rows = 0
-        for epoch in range(args.num_epochs):
+        from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+        for epoch in plan_ir.epoch_range(0, args.num_epochs):
             ds.set_epoch(epoch)
             for (images,), labels in ds:
                 params, opt_state, loss = step(params, opt_state, images,

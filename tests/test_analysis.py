@@ -548,6 +548,31 @@ RAW_DATASET_READ_OK = """
         return table, meta
 """
 
+STATIC_EPOCH_RANGE_BAD = """
+    def drive(dataset):
+        for epoch in range(dataset.num_epochs):
+            dataset.set_epoch(epoch)
+"""
+
+STATIC_EPOCH_SUBSCRIPT_BAD = """
+    def first_window_refs(epoch_refs):
+        return epoch_refs[0]
+"""
+
+STATIC_EPOCH_OK = """
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+
+    def drive(dataset, epoch_refs):
+        # plan-derived epoch sequence; dynamic per-epoch indexing
+        for epoch in plan_ir.epoch_range(dataset.start_epoch,
+                                         dataset.num_epochs):
+            dataset.set_epoch(epoch)
+            current = epoch_refs[epoch]
+        for step in range(3):  # non-epoch ranges pass
+            pass
+        return current
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -586,6 +611,11 @@ CASES = [
      {"path": "ray_shuffling_data_loader_tpu/workers.py"}),
     ("raw-dataset-read", RAW_DATASET_READ_BAD, RAW_DATASET_READ_OK,
      {"path": "ray_shuffling_data_loader_tpu/shuffle.py"}),
+    ("static-epoch-assumption", STATIC_EPOCH_RANGE_BAD, STATIC_EPOCH_OK,
+     {"path": "ray_shuffling_data_loader_tpu/jax_dataset.py"}),
+    ("static-epoch-assumption", STATIC_EPOCH_SUBSCRIPT_BAD,
+     STATIC_EPOCH_OK,
+     {"path": "ray_shuffling_data_loader_tpu/multiqueue_service.py"}),
 ]
 
 
@@ -600,6 +630,19 @@ def test_lineage_outside_plan_scoped_to_library_code():
     flagged, _ = lint(LINEAGE_PLAN_ROUTE_BAD,
                       path="ray_shuffling_data_loader_tpu/dataset.py")
     assert "lineage-outside-plan" in flagged
+
+
+def test_static_epoch_assumption_scoped_to_library_code():
+    """plan/ enumerates epoch schedules and streaming/ derives epochs
+    from windows — both exempt; tests and tools count epochs freely."""
+    for exempt in ("ray_shuffling_data_loader_tpu/plan/ir.py",
+                   "ray_shuffling_data_loader_tpu/streaming/runner.py",
+                   "tests/test_x.py", "tools/rsdl_plan.py"):
+        flagged, _ = lint(STATIC_EPOCH_RANGE_BAD, path=exempt)
+        assert "static-epoch-assumption" not in flagged, exempt
+    flagged, _ = lint(STATIC_EPOCH_RANGE_BAD,
+                      path="ray_shuffling_data_loader_tpu/jax_dataset.py")
+    assert "static-epoch-assumption" in flagged
 
 
 def test_unregistered_metric_scoped_to_library_code():

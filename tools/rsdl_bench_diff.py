@@ -85,6 +85,17 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
     {"key": "remote_rows_per_sec", "mode": "lower_bad", "pct": 20.0},
     {"key": "remote_prefetch_speedup_x", "mode": "lower_bad",
      "pct": 25.0},
+    # Streaming leg (streaming/): windowed end-to-end rate over the
+    # synthetic stream, the pipelining watermark lag (stream seconds —
+    # deterministic arrivals, so a lag jump means the assembler or the
+    # serve path stalled, not the host), and the window seal cost.
+    # Records older than r09 lack these keys, so the relative rules
+    # skip cleanly against pre-streaming baselines.
+    {"key": "stream_rows_per_sec", "mode": "lower_bad", "pct": 20.0},
+    {"key": "watermark_lag_p99_s", "mode": "higher_bad", "pct": 100.0,
+     "slack": 5.0},
+    {"key": "window_close_ms", "mode": "higher_bad", "pct": 150.0,
+     "slack": 200.0},
 ]
 
 

@@ -243,6 +243,29 @@ def render_storage(parsed: dict) -> list:
     return lines
 
 
+def render_streaming(parsed: dict) -> list:
+    """One streaming line (streaming/): current window, watermark lag in
+    stream seconds (the watermark_lag detector's series), and the late-
+    event count — the "is online training keeping up" one-liner. Silent
+    when the process never streamed."""
+    closed = _scalar(parsed, "rsdl_stream_windows_closed_total")
+    events = _scalar(parsed, "rsdl_stream_events_admitted_total")
+    if not closed and not events:
+        return []
+    window = _scalar(parsed, "rsdl_stream_window")
+    lag = _scalar(parsed, "rsdl_stream_watermark_lag_seconds")
+    late = _scalar(parsed, "rsdl_stream_late_events_total")
+    line = (f"streaming: window {int(window)} ({int(closed)} closed, "
+            f"{int(events)} events)   lag {lag:.1f}s")
+    if late:
+        by_policy = _by_label(parsed, "rsdl_stream_late_events_total",
+                              "policy")
+        detail = " ".join(f"{policy}={int(n)}"
+                          for policy, n in sorted(by_policy.items()))
+        line += f"   late {int(late)} ({detail})"
+    return [line]
+
+
 def render_latency(parsed: dict, before: dict = None) -> list:
     """Per-queue delivery-latency lines (runtime/latency.py sketch):
     p50/p95/p99 of the end-to-end birth->delivered hop plus the queue's
@@ -393,6 +416,7 @@ def render(parsed: dict, before: dict = None, interval_s: float = None
             f"server restarts: {int(restarts)}")
     lines.extend(render_shards(parsed))
     lines.extend(render_storage(parsed))
+    lines.extend(render_streaming(parsed))
     lines.extend(render_latency(parsed, before=before if rate_mode
                                 else None))
     # Critical-path line (runtime/trace.py gauges, refreshed per epoch):
