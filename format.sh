@@ -28,11 +28,39 @@ fi
 # goes through the package __init__, which needs numpy/pyarrow — skip
 # gracefully on images without them, same pattern as yapf/flake8 above).
 if python -c 'import ray_shuffling_data_loader_tpu.analysis' 2>/dev/null; then
-    echo "-- rsdl-lint"
-    python -m ray_shuffling_data_loader_tpu.analysis \
-        "${PY_DIRS[@]}" bench.py __graft_entry__.py tools
+    # --concurrency adds the whole-program pass (interprocedural
+    # locksets, lock-order cycle detection). When the archived runtime
+    # order graph is present, the run also cross-checks it against the
+    # static graph: dynamic acquisition edges the static pass missed
+    # are findings, static cycles confirmed at runtime hard-fail.
+    if [ -f .rsdl-locksan-graph.json ]; then
+        echo "-- rsdl-lint (concurrency + locksan cross-check)"
+        python -m ray_shuffling_data_loader_tpu.analysis --concurrency \
+            --locksan-graph .rsdl-locksan-graph.json \
+            "${PY_DIRS[@]}" bench.py __graft_entry__.py tools
+    else
+        echo "-- rsdl-lint (concurrency)"
+        python -m ray_shuffling_data_loader_tpu.analysis --concurrency \
+            "${PY_DIRS[@]}" bench.py __graft_entry__.py tools
+    fi
 else
     echo "-- rsdl-lint deps not importable, skipping"
+fi
+
+# Locksan archival run (RSDL_LOCKSAN_SUITE=1): replay tier-1 with every
+# package lock wrapped in the runtime sanitizer and rewrite the
+# committed .rsdl-locksan-graph.json artifact that the lint gate above
+# cross-checks. Off by default — it costs a full suite run; flip it on
+# after changing lock structure in the threaded modules so the archived
+# graph's construction-site keys stay in sync with the source.
+if [ "${RSDL_LOCKSAN_SUITE:-0}" = "1" ]; then
+    echo "-- locksan suite run (rewriting .rsdl-locksan-graph.json)"
+    RSDL_LOCKSAN=1 RSDL_LOCKSAN_OUT=.rsdl-locksan-graph.json \
+        python -m pytest tests/ -q -m 'not slow' \
+        -p no:cacheprovider >/dev/null
+    python -m ray_shuffling_data_loader_tpu.analysis --concurrency \
+        --locksan-graph .rsdl-locksan-graph.json \
+        "${PY_DIRS[@]}" bench.py __graft_entry__.py tools
 fi
 
 # Epoch-plan IR self-test (tools/rsdl_plan.py, stdlib-only): builds a

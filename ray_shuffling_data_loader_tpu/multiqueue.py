@@ -293,8 +293,15 @@ class MultiQueue:
         fut = self._async_pool.submit(fn, *args)
         with self._inflight_lock:
             self._inflight_async.add(fut)
-        fut.add_done_callback(
-            lambda f: self._inflight_async.discard(f))
+
+        def _discard(f: cf.Future) -> None:
+            # Done callbacks run on pool worker threads; an unlocked
+            # discard here can race close()'s locked snapshot of the
+            # set and blow up its list() copy mid-iteration.
+            with self._inflight_lock:
+                self._inflight_async.discard(f)
+
+        fut.add_done_callback(_discard)
         return fut
 
     def put_async(self, queue_index: int, item: Any) -> cf.Future:

@@ -175,7 +175,10 @@ class PlanScheduler:
                           else getattr(pool, "num_workers", 1))
         self._name = name or f"rsdl-plan-e{plan.epoch}"
         self._events: "queue_mod.Queue[tuple]" = queue_mod.Queue()
-        self._lock = threading.Lock()
+        # No instance lock on purpose: every field below is owned by
+        # the driver thread running the event loop (callbacks talk to
+        # it through self._events); a lock here would only disguise
+        # that confinement contract from the concurrency pass.
         self._lane_busy = [False] * self._lanes
         self._lane_queues: List["collections.deque[_NodeState]"] = [
             collections.deque() for _ in range(self._lanes)]

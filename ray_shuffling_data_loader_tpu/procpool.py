@@ -585,6 +585,13 @@ class ProcTaskRef(ex.TaskRef):
         self._transform = transform
         self._final = None
         self._final_error: Optional[BaseException] = None
+        # Runs the caller-supplied transform while held (exactly-once
+        # with side effects: ledger decrefs, segment unlinks), so
+        # locksan records edges into whatever locks that opaque
+        # callable takes — edges the static pass cannot resolve. Safe:
+        # this is a per-future leaf lock no transform can reach back
+        # into, so it cannot close a cycle.
+        # rsdl-lint: disable=inconsistent-lock-order
         self._final_lock = threading.Lock()
         self._finalized = False
 
@@ -820,18 +827,24 @@ class ProcessPoolExecutor:
             self._table_seg_bytes += nbytes
             if self._table_seg_bytes >= self.shm_bytes:
                 self._cache_full = True
-        self._ledger_ids.append(native.buffer_ledger().register(nbytes))
+        # Register outside the pool condition (the ledger has its own
+        # lock), but the id list is shared with _release_segments on
+        # the shutdown path, so the append itself goes back under it.
+        buf_id = native.buffer_ledger().register(nbytes)
+        with self._lock:
+            self._ledger_ids.append(buf_id)
 
     def _release_segments(self) -> None:
         from ray_shuffling_data_loader_tpu import native
         import shutil as _shutil
         ledger = native.buffer_ledger()
-        for buf_id in self._ledger_ids:
+        with self._lock:
+            ledger_ids, self._ledger_ids = self._ledger_ids, []
+        for buf_id in ledger_ids:
             try:
                 ledger.decref(buf_id)
             except KeyError:
                 pass
-        self._ledger_ids = []
         _shutil.rmtree(self.segment_dir, ignore_errors=True)
 
     # -- Worker lifecycle ----------------------------------------------

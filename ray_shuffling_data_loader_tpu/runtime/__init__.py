@@ -27,10 +27,15 @@ watchdog's stats hookup):
   per-batch bottleneck attribution every stage reports through.
 - :mod:`.metrics` — the typed counter/gauge/histogram registry with
   Prometheus text-format exposition (file + localhost HTTP).
+- :mod:`.locksan` — the opt-in (``RSDL_LOCKSAN=1``) runtime lock
+  sanitizer: wraps package-allocated locks to record the actual
+  acquisition-order graph and held-while-blocking events, emitted as
+  the JSON artifact that ``rsdl-lint --concurrency --locksan-graph``
+  cross-checks against the static lock-order graph.
 """
 
 from ray_shuffling_data_loader_tpu.runtime import (  # noqa: F401
-    faults, metrics, policy, release, retry, telemetry, watchdog)
+    faults, locksan, metrics, policy, release, retry, telemetry, watchdog)
 from ray_shuffling_data_loader_tpu.runtime.faults import (  # noqa: F401
     InjectedFault, QuarantinedFile)
 from ray_shuffling_data_loader_tpu.runtime.retry import (  # noqa: F401
@@ -38,6 +43,6 @@ from ray_shuffling_data_loader_tpu.runtime.retry import (  # noqa: F401
 from ray_shuffling_data_loader_tpu.runtime.watchdog import (  # noqa: F401
     StallReport, Watchdog, get_watchdog)
 
-__all__ = ["faults", "metrics", "policy", "release", "retry", "telemetry",
-           "watchdog", "InjectedFault", "QuarantinedFile", "RetryPolicy",
-           "StallReport", "Watchdog", "get_watchdog"]
+__all__ = ["faults", "locksan", "metrics", "policy", "release", "retry",
+           "telemetry", "watchdog", "InjectedFault", "QuarantinedFile",
+           "RetryPolicy", "StallReport", "Watchdog", "get_watchdog"]

@@ -6,12 +6,34 @@ This must run before jax is imported anywhere in the test process.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 xla_flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in xla_flags:
     os.environ["XLA_FLAGS"] = (
         xla_flags + " --xla_force_host_platform_device_count=8").strip()
+
+# Runtime lock sanitizer (RSDL_LOCKSAN=1): must be live before any
+# package module allocates its locks, and importing the package here
+# would defeat that (runtime/__init__ eagerly pulls the threaded
+# modules). Load locksan.py standalone, pre-seeded under its canonical
+# name so the later package import reuses this module — and its
+# recorded state — instead of a fresh, unpatched copy.
+_LOCKSAN = None
+if os.environ.get("RSDL_LOCKSAN") == "1":
+    import importlib.util
+
+    _repo_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    _locksan_name = "ray_shuffling_data_loader_tpu.runtime.locksan"
+    _locksan_spec = importlib.util.spec_from_file_location(
+        _locksan_name,
+        os.path.join(_repo_root, "ray_shuffling_data_loader_tpu",
+                     "runtime", "locksan.py"))
+    _LOCKSAN = importlib.util.module_from_spec(_locksan_spec)
+    sys.modules[_locksan_name] = _LOCKSAN
+    _locksan_spec.loader.exec_module(_LOCKSAN)
+    _LOCKSAN.install(root=_repo_root)
 
 import jax  # noqa: E402
 
@@ -32,3 +54,14 @@ def rng():
 @pytest.fixture
 def tmp_parquet_dir(tmp_path):
     return str(tmp_path / "parquet")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    if _LOCKSAN is not None and _LOCKSAN.installed():
+        out = _LOCKSAN.dump()
+        g = _LOCKSAN.graph()
+        cyc = _LOCKSAN.cycles(g)
+        sys.stderr.write(
+            f"\n[locksan] order graph -> {out}: {len(g['nodes'])} lock "
+            f"site(s), {len(g['edges'])} edge(s), {len(g['events'])} "
+            f"event(s), {len(cyc)} cycle(s)\n")

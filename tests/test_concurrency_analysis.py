@@ -1,0 +1,416 @@
+"""Whole-program concurrency pass + runtime lock sanitizer tests.
+
+Covers the ``--concurrency`` tentpole end to end:
+
+- synthetic two-module AB/BA inversion caught *statically* by
+  ``inconsistent-lock-order``;
+- ``unguarded-shared-mutation`` fixtures (flagged, pragma-suppressed,
+  and caller-holds-the-lock credited via the entry-held fixpoint);
+- a *live* two-thread inversion caught by the locksan runtime
+  sanitizer in a subprocess (global factory patching stays isolated);
+- the static<->dynamic cross-check round-trip on the same fixture
+  files, so ``path:line`` keys must agree between the two graphs;
+- registry scoping: the program rules must stay out of the per-file
+  registry (and the CLI must reject dynamic-graph flags without
+  ``--concurrency``);
+- the repo-wide acceptance pin: the concurrency pass is clean over the
+  package, the static order graph is acyclic, and the committed
+  locksan artifact (when present) cross-checks clean.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ray_shuffling_data_loader_tpu.analysis import core, locksets
+from ray_shuffling_data_loader_tpu.runtime import locksan
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = "ray_shuffling_data_loader_tpu"
+
+LOCKS_SRC = """\
+import threading
+
+LOCK_A = threading.Lock()
+LOCK_B = threading.Lock()
+"""
+LOCK_A_LINE = 3
+LOCK_B_LINE = 4
+
+AB_SRC = """\
+from pkgx.locks import LOCK_A, LOCK_B
+
+
+def ab():
+    with LOCK_A:
+        with LOCK_B:
+            pass
+"""
+
+BA_SRC = """\
+from pkgx.locks import LOCK_A, LOCK_B
+
+
+def ba():
+    with LOCK_B:
+        with LOCK_A:
+            pass
+"""
+
+
+def _write_fixture(tmp_path, files):
+    pkg = tmp_path / "pkgx"
+    pkg.mkdir(exist_ok=True)
+    (pkg / "__init__.py").write_text("")
+    for name, src in files.items():
+        (pkg / name).write_text(textwrap.dedent(src))
+    return tmp_path
+
+
+def _run_pass(tmp_path, locksan_graph=None, **config_kwargs):
+    config_kwargs.setdefault("concurrency_globs", ("pkgx/*",))
+    config = core.Config(**config_kwargs)
+    return core.check_program_paths(
+        ["pkgx"], config=config, root=str(tmp_path),
+        locksan_graph=locksan_graph)
+
+
+# ---------------------------------------------------------------------------
+# Static: inconsistent-lock-order
+# ---------------------------------------------------------------------------
+
+
+def test_ab_ba_inversion_across_modules_caught_statically(tmp_path):
+    _write_fixture(tmp_path, {"locks.py": LOCKS_SRC,
+                              "mod_a.py": AB_SRC, "mod_b.py": BA_SRC})
+    violations, analysis = _run_pass(tmp_path)
+    assert [v.rule for v in violations] == ["inconsistent-lock-order"]
+    msg = violations[0].message
+    # Both acquisition chains must be named, with file:line witnesses.
+    assert "LOCK_A" in msg and "LOCK_B" in msg
+    assert "mod_a.py" in msg and "mod_b.py" in msg
+    assert "potential deadlock" in msg
+    assert len(analysis.cycles()) == 1
+
+
+def test_consistent_order_is_clean(tmp_path):
+    _write_fixture(tmp_path, {
+        "locks.py": LOCKS_SRC,
+        "mod_a.py": AB_SRC,
+        "mod_b.py": AB_SRC.replace("def ab", "def ab2"),
+    })
+    violations, analysis = _run_pass(tmp_path)
+    assert violations == []
+    assert analysis.cycles() == []
+
+
+def test_dynamic_edge_missing_from_static_graph_is_flagged(tmp_path):
+    # Static program only ever nests B->A; a locksan dump observing
+    # A->B is (a) an order-RELEVANT analysis gap (B has outgoing
+    # edges, so the chain can extend), anchored at the HELD lock's
+    # construction site (where a justifying pragma goes), and (b) a
+    # union cycle: neither view alone has one, merged they deadlock.
+    _write_fixture(tmp_path, {"locks.py": LOCKS_SRC, "mod_b.py": BA_SRC})
+    a_key = f"pkgx/locks.py:{LOCK_A_LINE}"
+    b_key = f"pkgx/locks.py:{LOCK_B_LINE}"
+    dyn = {"kind": "rsdl-lock-order-graph", "source": "dynamic",
+           "nodes": [{"key": a_key, "kind": "Lock"},
+                     {"key": b_key, "kind": "Lock"}],
+           "edges": [{"src": a_key, "dst": b_key, "count": 3,
+                      "same_instance": False}]}
+    violations, _ = _run_pass(tmp_path, locksan_graph=dyn)
+    assert {v.rule for v in violations} == {"inconsistent-lock-order"}
+    missing = [v for v in violations if "missing" in v.message]
+    assert len(missing) == 1
+    assert missing[0].path == "pkgx/locks.py"
+    assert missing[0].line == LOCK_A_LINE
+    union = [v for v in violations
+             if "static + runtime edges combined" in v.message]
+    assert len(union) == 1
+
+
+def test_dynamic_edge_into_leaf_lock_is_benign(tmp_path):
+    # Nothing is ever acquired while holding B (statically or at
+    # runtime), so an observed A->B edge cannot participate in any
+    # cycle: recorded as benign, not flagged — component locks held
+    # across a metrics increment would otherwise each need a pragma.
+    _write_fixture(tmp_path, {"locks.py": LOCKS_SRC, "mod_a.py": """\
+        from pkgx.locks import LOCK_A, LOCK_B
+
+
+        def a_only():
+            with LOCK_A:
+                pass
+
+
+        def b_only():
+            with LOCK_B:
+                pass
+        """})
+    a_key = f"pkgx/locks.py:{LOCK_A_LINE}"
+    b_key = f"pkgx/locks.py:{LOCK_B_LINE}"
+    dyn = {"kind": "rsdl-lock-order-graph", "source": "dynamic",
+           "nodes": [{"key": a_key, "kind": "Lock"},
+                     {"key": b_key, "kind": "Lock"}],
+           "edges": [{"src": a_key, "dst": b_key, "count": 3,
+                      "same_instance": False}]}
+    violations, analysis = _run_pass(tmp_path, locksan_graph=dyn)
+    assert violations == []
+    report = locksets.crosscheck(analysis.static_graph(), dyn)
+    assert report["missing_edges"] == []
+    assert len(report["benign_leaf_edges"]) == 1
+
+
+def test_static_cycle_confirmed_by_dynamic_graph_is_hard_failure(tmp_path):
+    _write_fixture(tmp_path, {"locks.py": LOCKS_SRC,
+                              "mod_a.py": AB_SRC, "mod_b.py": BA_SRC})
+    a_key = f"pkgx/locks.py:{LOCK_A_LINE}"
+    b_key = f"pkgx/locks.py:{LOCK_B_LINE}"
+    dyn = {"kind": "rsdl-lock-order-graph", "source": "dynamic",
+           "nodes": [{"key": a_key, "kind": "Lock"},
+                     {"key": b_key, "kind": "Lock"}],
+           "edges": [{"src": a_key, "dst": b_key, "count": 1,
+                      "same_instance": False},
+                     {"src": b_key, "dst": a_key, "count": 1,
+                      "same_instance": False}]}
+    violations, _ = _run_pass(tmp_path, locksan_graph=dyn)
+    cycle = [v for v in violations if "DEADLOCK CONFIRMED" in v.message]
+    assert len(cycle) == 1
+
+
+# ---------------------------------------------------------------------------
+# Static: unguarded-shared-mutation
+# ---------------------------------------------------------------------------
+
+STORE_SRC = """\
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, x):
+        with self._lock:
+            self._items.append(x)
+
+    def drop(self, x):
+        with self._lock:
+            self._items.remove(x)
+
+    def sneak(self, x):
+        self._items.append(x)
+"""
+
+
+def test_unguarded_shared_mutation_flagged(tmp_path):
+    _write_fixture(tmp_path, {"store.py": STORE_SRC})
+    violations, _ = _run_pass(tmp_path)
+    assert [v.rule for v in violations] == ["unguarded-shared-mutation"]
+    v = violations[0]
+    assert v.path == "pkgx/store.py"
+    assert "sneak" in v.message and "_lock" in v.message
+    assert "_items" in v.message
+
+
+def test_unguarded_shared_mutation_pragma_suppresses(tmp_path):
+    # Patch the LAST occurrence (sneak's body), not add's.
+    src = STORE_SRC[:STORE_SRC.rindex("        self._items.append(x)")] + \
+        "        # rsdl-lint: disable=unguarded-shared-mutation\n" + \
+        "        self._items.append(x)\n"
+    _write_fixture(tmp_path, {"store.py": src})
+    violations, _ = _run_pass(tmp_path)
+    assert violations == []
+
+
+def test_writes_credited_through_entry_held_callers(tmp_path):
+    # _bump writes bare lexically, but its only call site holds the
+    # lock — the interprocedural entry-held fixpoint must credit it.
+    _write_fixture(tmp_path, {"counter.py": """\
+        import threading
+
+
+        class Counter:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+                self._m = 0
+
+            def incr(self):
+                with self._lock:
+                    self._n += 1
+                    self._bump()
+
+            def set_both(self, v):
+                with self._lock:
+                    self._n = v
+                    self._m = v
+
+            def _bump(self):
+                self._m += 1
+                self._n += 1
+        """})
+    violations, _ = _run_pass(tmp_path)
+    assert violations == []
+
+
+# ---------------------------------------------------------------------------
+# Dynamic: locksan in a live subprocess + static<->dynamic round-trip
+# ---------------------------------------------------------------------------
+
+DRIVER_SRC = """\
+import importlib.util
+import os
+import sys
+import threading
+
+repo_root, out = sys.argv[1], sys.argv[2]
+name = "ray_shuffling_data_loader_tpu.runtime.locksan"
+spec = importlib.util.spec_from_file_location(
+    name, os.path.join(repo_root, "ray_shuffling_data_loader_tpu",
+                       "runtime", "locksan.py"))
+locksan = importlib.util.module_from_spec(spec)
+sys.modules[name] = locksan
+spec.loader.exec_module(locksan)
+locksan.install(root=os.getcwd(), include=("pkgx/",))
+
+sys.path.insert(0, os.getcwd())
+import pkgx.mod_a, pkgx.mod_b  # noqa: E401,E402 - allocates the locks
+
+# Two threads, run to completion one after the other: the opposing
+# acquisition orders are recorded without risking a real deadlock.
+for fn in (pkgx.mod_a.ab, pkgx.mod_b.ba):
+    t = threading.Thread(target=fn)
+    t.start()
+    t.join()
+locksan.dump(out)
+"""
+
+
+@pytest.fixture
+def dynamic_graph(tmp_path):
+    _write_fixture(tmp_path, {"locks.py": LOCKS_SRC,
+                              "mod_a.py": AB_SRC, "mod_b.py": BA_SRC})
+    driver = tmp_path / "driver.py"
+    driver.write_text(DRIVER_SRC)
+    out = tmp_path / "order-graph.json"
+    subprocess.run([sys.executable, str(driver), REPO_ROOT, str(out)],
+                   cwd=str(tmp_path), check=True, timeout=60)
+    with open(out) as f:
+        return json.load(f)
+
+
+def test_live_two_thread_inversion_caught_by_locksan(dynamic_graph):
+    a_key = f"pkgx/locks.py:{LOCK_A_LINE}"
+    b_key = f"pkgx/locks.py:{LOCK_B_LINE}"
+    edges = {(e["src"], e["dst"]) for e in dynamic_graph["edges"]}
+    assert (a_key, b_key) in edges and (b_key, a_key) in edges
+    cycles = locksan.cycles(dynamic_graph)
+    assert len(cycles) == 1
+    assert set(cycles[0]) == {a_key, b_key}
+
+
+def test_static_dynamic_crosscheck_round_trip(tmp_path, dynamic_graph):
+    # Same fixture files feed both halves, so the construction-site
+    # keys must line up and the static cycle must come back CONFIRMED.
+    violations, analysis = _run_pass(tmp_path,
+                                     locksan_graph=dynamic_graph)
+    report = locksets.crosscheck(analysis.static_graph(), dynamic_graph)
+    assert report["missing_edges"] == []
+    assert len(report["confirmed_cycles"]) == 1
+    confirmed = [v for v in violations
+                 if "DEADLOCK CONFIRMED" in v.message]
+    assert len(confirmed) == 1
+
+
+# ---------------------------------------------------------------------------
+# Registry scoping + CLI contract
+# ---------------------------------------------------------------------------
+
+
+def test_program_rules_stay_out_of_per_file_registry():
+    per_file = set(core.all_rules())
+    program = set(core.program_rules())
+    assert program == {"inconsistent-lock-order",
+                       "unguarded-shared-mutation"}
+    assert not (per_file & program)
+
+
+def test_per_file_findings_identical_with_and_without_concurrency(
+        tmp_path):
+    # The whole-program pass must only ADD findings from its own two
+    # rules; the per-file rules' output is byte-identical either way.
+    target = tmp_path / "sample.py"
+    target.write_text(textwrap.dedent("""\
+        import threading
+
+        _lock = threading.Lock()
+
+
+        def risky(fut):
+            with _lock:
+                return fut.result()
+        """))
+    env = dict(os.environ, PYTHONDONTWRITEBYTECODE="1")
+    base = [sys.executable, "-m", f"{PKG}.analysis", "--no-baseline",
+            str(target)]
+    plain = subprocess.run(base, capture_output=True, text=True,
+                           cwd=REPO_ROOT, env=env, timeout=120)
+    conc = subprocess.run(base + ["--concurrency"], capture_output=True,
+                          text=True, cwd=REPO_ROOT, env=env, timeout=120)
+
+    def findings(out):
+        return [ln for ln in out.splitlines()
+                if not ln.startswith("rsdl-lint:")]
+
+    assert findings(plain.stdout) == findings(conc.stdout)
+
+
+def test_locksan_graph_flag_requires_concurrency(tmp_path):
+    target = tmp_path / "empty.py"
+    target.write_text("x = 1\n")
+    graph = tmp_path / "g.json"
+    graph.write_text("{}")
+    proc = subprocess.run(
+        [sys.executable, "-m", f"{PKG}.analysis", "--no-baseline",
+         "--locksan-graph", str(graph), str(target)],
+        capture_output=True, text=True, cwd=REPO_ROOT, timeout=120)
+    assert proc.returncode == core.EXIT_ERROR
+
+
+# ---------------------------------------------------------------------------
+# Repo-wide acceptance pins
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def repo_pass():
+    return core.check_program_paths([PKG], root=REPO_ROOT)
+
+
+def test_package_concurrency_pass_is_clean(repo_pass):
+    violations, analysis = repo_pass
+    assert violations == []
+    assert analysis.cycles() == []
+
+
+def test_committed_locksan_artifact_crosschecks_clean(repo_pass):
+    artifact = os.path.join(REPO_ROOT, ".rsdl-locksan-graph.json")
+    if not os.path.exists(artifact):
+        pytest.skip("no archived locksan order graph")
+    with open(artifact) as f:
+        dynamic = json.load(f)
+    # Through the rule (pragma-reconciled gaps apply): zero findings.
+    violations, analysis = core.check_program_paths(
+        [PKG], root=REPO_ROOT, locksan_graph=dynamic)
+    assert violations == []
+    # And no deadlock in any view: static, dynamic, or merged.
+    report = locksets.crosscheck(analysis.static_graph(), dynamic)
+    assert report["confirmed_cycles"] == []
+    assert report["union_cycles"] == []
+    assert locksan.cycles(dynamic) == []

@@ -401,6 +401,27 @@ def test_bench_diff_check_mode_is_informational():
     assert bd.main(["--check", REPO_ROOT]) == 0
 
 
+def test_bench_diff_derives_per_core_rate_for_old_records():
+    bd = _load_bench_diff()
+    # r03 predates the rows_per_s_per_core key but carries value +
+    # host_cpus; the per-core lower-bad rule must fire against it
+    # instead of silently skipping the one host-width-proof metric.
+    base = bd.derive_metrics(
+        bd.load_record(os.path.join(REPO_ROOT, "BENCH_r03.json")))
+    assert base["rows_per_s_per_core"] == pytest.approx(
+        base["value"] / base["host_cpus"])
+    findings = bd.compare_records(
+        base, bd.derive_metrics({"value": base["value"] * 0.5,
+                                 "host_cpus": base["host_cpus"]}))
+    per_core = [f for f in findings
+                if f["key"] == "rows_per_s_per_core"][0]
+    assert not per_core["ok"]
+    # An emitted value always wins over the derived one.
+    rec = bd.derive_metrics({"value": 100.0, "host_cpus": 4,
+                             "rows_per_s_per_core": 99.0})
+    assert rec["rows_per_s_per_core"] == 99.0
+
+
 def test_bench_diff_ceiling_applies_to_current_only():
     bd = _load_bench_diff()
     findings = bd.compare_records(

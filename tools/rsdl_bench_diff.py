@@ -111,6 +111,25 @@ def load_record(path: str) -> Dict[str, Any]:
     return data
 
 
+def derive_metrics(record: Dict[str, Any]) -> Dict[str, Any]:
+    """Fill in metrics computable from what the record does carry.
+
+    ``rows_per_s_per_core`` only started being emitted in r05, but
+    r03/r04 already carried ``value`` (rows/s) and ``host_cpus`` — and
+    the per-core rule is the one that survives a host-width change, so
+    silently skipping it against pre-r05 baselines hides exactly the
+    normalization it exists for. Derive it (value / host_cpus) when
+    absent; emitted values always win over derived ones.
+    """
+    if _num(record, "rows_per_s_per_core") is None:
+        value = _num(record, "value")
+        cpus = _num(record, "host_cpus")
+        if value is not None and cpus is not None and cpus > 0:
+            record = dict(record)
+            record["rows_per_s_per_core"] = value / cpus
+    return record
+
+
 def _num(record: Dict[str, Any], key: str) -> Optional[float]:
     value = record.get(key)
     if isinstance(value, bool) or not isinstance(value, (int, float)):
@@ -237,8 +256,8 @@ def main(argv: Optional[List[str]] = None) -> int:
         hard = True
 
     try:
-        base = load_record(base_path)
-        cur = load_record(cur_path)
+        base = derive_metrics(load_record(base_path))
+        cur = derive_metrics(load_record(cur_path))
     except (OSError, ValueError) as e:
         print(f"bench-diff: {e}", file=sys.stderr)
         return 2
