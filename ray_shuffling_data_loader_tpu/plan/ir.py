@@ -42,10 +42,15 @@ from __future__ import annotations
 import dataclasses
 import itertools
 import json
+import re
 from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
 
 #: Serialization format version (bumped on breaking shape changes).
 PLAN_VERSION = 1
+
+#: Mirrors tenancy/__init__.py's id shape (this module stays import-free
+#: so tools can load it by file path without the package).
+_TENANT_ID_RE = re.compile(r"^[a-z0-9][a-z0-9_.-]{0,63}$")
 
 #: Shard-map serialization version (the serving-plane config, PR 10).
 SHARD_MAP_VERSION = 1
@@ -206,7 +211,13 @@ class EpochPlan:
     ``{"index", "policy", "ingest_watermark", "late_events"}`` here so
     recovery and tools can see which stream window an epoch came from.
     ``None`` (the static-file-list case) serializes to nothing — plans
-    from the pre-streaming world stay byte-identical."""
+    from the pre-streaming world stay byte-identical.
+
+    ``tenant_id`` names the tenant the epoch is served FOR
+    (tenancy/__init__.py): the serving plane attributes queue bytes
+    and the storage plane attributes cache residency to it. Like
+    ``window``, ``None`` serializes to nothing so single-tenant plans
+    stay byte-identical with every pre-tenancy journal."""
 
     seed: int
     epoch: int
@@ -216,6 +227,7 @@ class EpochPlan:
     nodes: Dict[str, PlanNode] = dataclasses.field(default_factory=dict)
     version: int = PLAN_VERSION
     window: Optional[Dict[str, Any]] = None
+    tenant_id: Optional[str] = None
 
     # -- queries --------------------------------------------------------
 
@@ -282,6 +294,12 @@ class EpochPlan:
             except (KeyError, TypeError, ValueError) as e:
                 raise PlanError(
                     f"malformed window metadata {self.window!r}: {e}") from e
+        if self.tenant_id is not None:
+            if not isinstance(self.tenant_id, str) \
+                    or not _TENANT_ID_RE.match(self.tenant_id):
+                raise PlanError(
+                    f"invalid tenant_id {self.tenant_id!r}: want "
+                    "^[a-z0-9][a-z0-9_.-]{0,63}$")
         maps, reduces, routes = [], [], []
         for nid, node in self.nodes.items():
             if node.id != nid:
@@ -367,6 +385,10 @@ class EpochPlan:
             # After "nodes" on purpose: absent for static plans, so the
             # pre-streaming serialization stays byte-identical.
             d["window"] = dict(sorted(self.window.items()))
+        if self.tenant_id is not None:
+            # Same back-compat contract as "window": single-tenant
+            # plans serialize byte-identically to pre-tenancy ones.
+            d["tenant_id"] = self.tenant_id
         return d
 
     def to_json(self, indent: Optional[int] = None) -> str:
@@ -384,7 +406,8 @@ class EpochPlan:
                        num_trainers=int(data["num_trainers"]),
                        filenames=[str(f) for f in data["filenames"]],
                        version=int(data.get("version", PLAN_VERSION)),
-                       window=dict(window) if window is not None else None)
+                       window=dict(window) if window is not None else None,
+                       tenant_id=data.get("tenant_id"))
         except (KeyError, TypeError, ValueError) as e:
             raise PlanError(f"malformed plan: {e}") from e
         for node_data in data.get("nodes", ()):
@@ -407,16 +430,19 @@ def from_json(text: str) -> EpochPlan:
 
 def build_epoch_plan(filenames: Iterable[str], num_reducers: int,
                      num_trainers: int, seed: int, epoch: int,
-                     window: Optional[Dict[str, Any]] = None) -> EpochPlan:
+                     window: Optional[Dict[str, Any]] = None,
+                     tenant_id: Optional[str] = None) -> EpochPlan:
     """Build (and validate) the canonical plan of one epoch:
     one map node per file, one reduce node per reducer (depending on
     every map), one route node per trainer rank consuming its contiguous
     reducer span and naming its queue index. ``window`` stamps streaming
-    provenance onto the plan (closed-window epochs)."""
+    provenance onto the plan (closed-window epochs); ``tenant_id``
+    stamps the owning tenant (tenancy plans)."""
     plan = EpochPlan(seed=seed, epoch=epoch, num_reducers=num_reducers,
                      num_trainers=num_trainers,
                      filenames=[str(f) for f in filenames],
-                     window=dict(window) if window is not None else None)
+                     window=dict(window) if window is not None else None,
+                     tenant_id=tenant_id)
     map_ids = []
     for file_index, filename in enumerate(plan.filenames):
         nid = node_id("map", epoch, file_index)
@@ -466,17 +492,21 @@ class EpochSpec:
     epoch: int
     filenames: Tuple[str, ...]
     window: Optional[Dict[str, Any]] = None
+    tenant_id: Optional[str] = None
 
 
 def static_epoch_specs(filenames: Iterable[str], num_epochs: int,
-                       start_epoch: int = 0) -> Iterable[EpochSpec]:
+                       start_epoch: int = 0,
+                       tenant_id: Optional[str] = None
+                       ) -> Iterable[EpochSpec]:
     """The classic epochs-over-a-fixed-file-list schedule as an epoch-spec
     iterator: every epoch reshuffles the same files, ``start_epoch``
     resumes mid-trial. THE one place the per-trial epoch range is
     enumerated (shuffle.py consumes the iterator, never the count)."""
     files = tuple(str(f) for f in filenames)
     for epoch in range(start_epoch, num_epochs):
-        yield EpochSpec(epoch=epoch, filenames=files)
+        yield EpochSpec(epoch=epoch, filenames=files,
+                        tenant_id=tenant_id)
 
 
 def epoch_range(start_epoch: int, num_epochs: Optional[int]):

@@ -467,6 +467,69 @@ class CacheThrashDetector(Detector):
         return None
 
 
+class TenantCacheThrashDetector(Detector):
+    """Per-tenant cache thrash: the :class:`CacheThrashDetector` joint
+    condition evaluated per tenant label over the
+    ``rsdl_tenant_storage_*`` series (storage/cache.py attributes every
+    hot-tier hit/miss/eviction to the ambient TenantContext).
+
+    The aggregate detector can stay green while one tenant churns —
+    its evictions diluted by a neighbor's hits. This one names the
+    thrashing tenant, which is also the actionable unit: the fix is
+    that tenant's ``cache_quota_bytes``, not the global budget."""
+
+    name = "tenant_cache_thrash"
+
+    def __init__(self, component: str = "health", **overrides: Any):
+        super().__init__(component, **overrides)
+        self.evictions_per_min = self._resolve("slo_cache_evictions_per_min")
+        self.hit_pct = self._resolve("slo_cache_hit_pct")
+        self.window_ticks = self._resolve("slo_droop_window_ticks")
+
+    def _tenants(self, ring: rt_history.HistoryRing) -> List[str]:
+        snaps = ring.snapshots()
+        if not snaps:
+            return []
+        series = snaps[-1]["samples"].get(
+            "rsdl_tenant_storage_evictions_total", {})
+        return sorted({dict(labels).get("tenant", "")
+                       for labels in series} - {""})
+
+    def evaluate(self, ring: rt_history.HistoryRing) -> Optional[Breach]:
+        window = max(1, int(self.window_ticks))
+        worst = None
+        for tenant in self._tenants(ring):
+            labels = {"tenant": tenant}
+            evict_rates = ring.rate("rsdl_tenant_storage_evictions_total",
+                                    labels=labels, window_ticks=window)
+            if not evict_rates:
+                continue
+            evict_per_min = evict_rates[-1][1] * 60.0
+            if evict_per_min <= self.evictions_per_min:
+                continue
+            hits = ring.series("rsdl_tenant_storage_hits_total",
+                               labels=labels)
+            misses = ring.series("rsdl_tenant_storage_misses_total",
+                                 labels=labels)
+            if len(hits) <= window or len(misses) <= window:
+                continue
+            dh = max(0.0, hits[-1][1] - hits[-1 - window][1])
+            dm = max(0.0, misses[-1][1] - misses[-1 - window][1])
+            if dh + dm <= 0:
+                continue
+            hit_pct = 100.0 * dh / (dh + dm)
+            if hit_pct < self.hit_pct and (
+                    worst is None or evict_per_min > worst[0]):
+                worst = (evict_per_min, hit_pct, tenant)
+        if worst is not None:
+            evict_per_min, hit_pct, tenant = worst
+            return self._breach(
+                evict_per_min, self.evictions_per_min,
+                f"tenant {tenant} evicting {evict_per_min:.1f}/min at "
+                f"{hit_pct:.1f}% hit rate (floor {self.hit_pct:.0f}%)")
+        return None
+
+
 class WatermarkLagDetector(Detector):
     """Streaming ingest running away from serving.
 
@@ -502,7 +565,7 @@ _DETECTOR_TYPES: Dict[str, type] = {
         ThroughputDroopDetector, StallBreachDetector, LedgerCreepDetector,
         QueueSaturationDetector, LeaseChurnDetector, StragglerDriftDetector,
         DeliveryLatencyDetector, FreshnessStallDetector, CacheThrashDetector,
-        WatermarkLagDetector)
+        TenantCacheThrashDetector, WatermarkLagDetector)
 }
 
 

@@ -76,6 +76,23 @@ METRIC_NAMES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     #    declared here) --
     "rsdl_delivery_latency_seconds": ("sketch", ("hop", "queue")),
     "rsdl_delivery_freshness_seconds": ("gauge", ("queue",)),
+    # -- tenancy plane (tenancy/: per-tenant QoS over the queue,
+    #    storage and admission planes; the tenant label is the bounded
+    #    configured-tenant vocabulary, validated by
+    #    tenancy.validate_tenant_id) --
+    "rsdl_tenant_bytes_delivered_total": ("counter", ("tenant",)),
+    "rsdl_tenant_replay_bytes": ("gauge", ("tenant",)),
+    "rsdl_tenant_budget_bytes": ("gauge", ("tenant",)),
+    "rsdl_tenant_delivery_latency_seconds": ("sketch", ("hop", "tenant")),
+    "rsdl_tenant_storage_hits_total": ("counter", ("tenant",)),
+    "rsdl_tenant_storage_misses_total": ("counter", ("tenant",)),
+    "rsdl_tenant_storage_evictions_total": ("counter", ("tenant",)),
+    "rsdl_tenant_cache_bytes": ("gauge", ("tenant",)),
+    "rsdl_tenant_cache_quota_bytes": ("gauge", ("tenant",)),
+    "rsdl_tenant_prefetch_throttled_total": ("counter", ("tenant",)),
+    "rsdl_admission_decisions_total": ("counter", ("action",)),
+    "rsdl_admission_waiting": ("gauge", ()),
+    "rsdl_admission_used_bytes": ("gauge", ()),
     # -- spill tier (spill.py) --
     "rsdl_spills_total": ("counter", ()),
     "rsdl_spilled_bytes_total": ("counter", ()),

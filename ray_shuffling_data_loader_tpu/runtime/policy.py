@@ -162,6 +162,18 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     # Consumer lease: seconds without a heartbeat/request before a
     # consumer is declared dead. Client heartbeats run at a third of it.
     "queue_lease_timeout_s": (30.0, float),
+    # Weighted-fair tenancy (tenancy/fairshare.py): the DRR replenish
+    # quantum (each round hands a tenant quantum*weight bytes of pop
+    # credit) and the activity window after which an idle tenant's
+    # share redistributes to the rest (work conservation).
+    "tenant_drr_quantum_bytes": (1 << 20, int),
+    "tenant_active_window_s": (1.0, float),
+    # Pace of the one-frame-per-GET liveness floor while the scheduler
+    # is denying a tenant: the denied GET is delayed this long before
+    # its floor frame pops. Without it a fast-RTT consumer's floor
+    # alone out-runs the DRR grants and the weights shape nothing.
+    # 0 disables pacing (floor at raw round-trip rate).
+    "tenant_floor_pace_s": (0.002, float),
     # Serving-plane table delivery (multiqueue_service v3): "auto"
     # (consumers on a loopback address offer shm-handle delivery and the
     # server sends segment handles instead of streaming table bytes;

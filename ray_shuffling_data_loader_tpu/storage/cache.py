@@ -43,6 +43,7 @@ from typing import Dict, Optional, Tuple
 import pyarrow as pa
 
 from ray_shuffling_data_loader_tpu import native
+from ray_shuffling_data_loader_tpu import tenancy as rt_tenancy
 from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
@@ -70,6 +71,17 @@ def _tier_counters(tier: str) -> Tuple[object, object, object, object]:
             rt_metrics.counter("rsdl_storage_misses_total", tier=tier),
             rt_metrics.counter("rsdl_storage_evictions_total", tier=tier),
             rt_metrics.counter("rsdl_storage_corrupt_total", tier=tier))
+
+
+def _tenant_counters(tenant_id: str) -> Tuple[object, object, object]:
+    """(hits, misses, evictions) counters for one tenant — the series
+    the per-tenant thrash detector (runtime/health.py) watches."""
+    return (rt_metrics.counter("rsdl_tenant_storage_hits_total",
+                               tenant=tenant_id),
+            rt_metrics.counter("rsdl_tenant_storage_misses_total",
+                               tenant=tenant_id),
+            rt_metrics.counter("rsdl_tenant_storage_evictions_total",
+                               tenant=tenant_id))
 
 
 class DiskTier:
@@ -355,7 +367,8 @@ class TieredStore:
 
     def __init__(self, hot_bytes: int,
                  disk: Optional[DiskTier] = None,
-                 source: Optional[object] = None):
+                 source: Optional[object] = None,
+                 tenant_quotas: Optional[Dict[str, int]] = None):
         self.hot_bytes = hot_bytes
         self.disk = disk
         self._source = source
@@ -365,6 +378,16 @@ class TieredStore:
         self._hot_bytes_used = 0
         self._lock = threading.Lock()
         self._prefetched: set = set()
+        # Tenancy partition of the hot tier: tenant_quotas caps each
+        # tenant's RESIDENT bytes; the ambient TenantContext's
+        # cache_quota_bytes fills in for tenants not in the table.
+        # Over-quota insertion evicts the tenant's OWN LRU entries, so
+        # one tenant's cold scan demotes its own pages, never a
+        # neighbor's working set.
+        self._tenant_quotas: Dict[str, int] = dict(tenant_quotas or {})
+        self._key_tenant: Dict[str, str] = {}
+        self._tenant_hot_bytes: Dict[str, int] = {}
+        self._tenant_metrics: Dict[str, Tuple[object, object, object]] = {}
         # key -> Event for warms in flight: a reader that misses both
         # tiers JOINS the warm (waits for the fetch already running on
         # a prefetch thread) instead of racing it with a duplicate
@@ -386,6 +409,8 @@ class TieredStore:
         # Bounded: each pass either returns or waits for ONE in-flight
         # warm of this key; when the warm resolves (success or not) the
         # re-probe either hits a tier or finds no warm and returns None.
+        tenant_id = rt_tenancy.current_tenant().tenant_id
+        t_hits, t_misses, _ = self._tenant_counters_for(tenant_id)
         while True:
             with self._lock:
                 table = self._hot.get(key)
@@ -397,6 +422,7 @@ class TieredStore:
                     was_prefetched = False
             if table is not None:
                 self._hot_hits.inc()
+                t_hits.inc()
                 if was_prefetched:
                     self._prefetch_hits.inc()
                 return table
@@ -410,11 +436,13 @@ class TieredStore:
                     if was_prefetched:
                         self._prefetch_hits.inc()
                     self._promote(key, table)
+                    t_hits.inc()
                     return table
             with self._lock:
                 event = self._warming.get(key)
             if event is None:
                 self._remote_misses.inc()
+                t_misses.inc()
                 return None
             # A prefetch thread is already fetching this key: join it —
             # the wait is the REMAINDER of a transfer that started on
@@ -447,35 +475,102 @@ class TieredStore:
             self._hot_bytes_used = 0
             self._hot_gauge.set(0)
             self._prefetched.clear()
+            self._key_tenant.clear()
+            for tenant_id in self._tenant_hot_bytes:
+                rt_metrics.gauge("rsdl_tenant_cache_bytes",
+                                 tenant=tenant_id).set(0)
+            self._tenant_hot_bytes.clear()
         if self.disk is not None:
             self.disk.close()
 
     # -- internals -----------------------------------------------------
 
+    def _tenant_counters_for(self, tenant_id: str
+                             ) -> Tuple[object, object, object]:
+        counters = self._tenant_metrics.get(tenant_id)
+        if counters is None:
+            counters = _tenant_counters(tenant_id)
+            self._tenant_metrics[tenant_id] = counters
+        return counters
+
+    def _tenant_quota(self, tenant_id: str) -> Optional[int]:
+        """This tenant's hot-tier byte cap: the explicit quota table
+        first, the ambient context's cache_quota_bytes second, None
+        (share the global budget unpartitioned) otherwise."""
+        quota = self._tenant_quotas.get(tenant_id)
+        if quota is None:
+            ctx = rt_tenancy.current_tenant()
+            if ctx.tenant_id == tenant_id:
+                quota = ctx.cache_quota_bytes
+        if quota is not None:
+            rt_metrics.gauge("rsdl_tenant_cache_quota_bytes",
+                             tenant=tenant_id).set(quota)
+        return quota
+
+    def _drop_hot_locked(self, key: str, table: pa.Table) -> str:
+        """Remove ``key`` from the hot tier (caller holds ``_lock`` —
+        the ``_locked`` suffix is the contract); returns the tenant the
+        entry was charged to."""
+        # rsdl-lint: disable=lock-mutation
+        self._hot_bytes_used -= table.nbytes
+        tenant_id = self._key_tenant.pop(key, rt_tenancy.DEFAULT_TENANT_ID)
+        # rsdl-lint: disable=lock-mutation
+        self._tenant_hot_bytes[tenant_id] = \
+            self._tenant_hot_bytes.get(tenant_id, 0) - table.nbytes
+        return tenant_id
+
     def _promote(self, key: str, table: pa.Table) -> bool:
         nbytes = table.nbytes
-        evicted = []
+        tenant_id = rt_tenancy.current_tenant().tenant_id
+        quota = self._tenant_quota(tenant_id)
+        evicted = []  # (key, charged tenant)
         with self._lock:
             if key in self._hot:
                 self._hot.move_to_end(key)
                 return True
+            if quota is not None and nbytes > quota:
+                return False  # can never fit this tenant's partition
+            # Tenant-preferential eviction: an over-quota tenant demotes
+            # its OWN least-recent entries; neighbors' working sets stay
+            # resident no matter how cold this tenant's scan runs.
+            if quota is not None:
+                while (self._tenant_hot_bytes.get(tenant_id, 0) + nbytes
+                       > quota):
+                    victim = next(
+                        (k for k in self._hot
+                         if self._key_tenant.get(k) == tenant_id), None)
+                    if victim is None:
+                        break
+                    old = self._hot.pop(victim)
+                    evicted.append((victim, self._drop_hot_locked(
+                        victim, old)))
             while (self._hot_bytes_used + nbytes > self.hot_bytes
                    and self._hot):
                 old_key, old = self._hot.popitem(last=False)
-                self._hot_bytes_used -= old.nbytes
-                evicted.append(old_key)
+                evicted.append((old_key, self._drop_hot_locked(
+                    old_key, old)))
             if self._hot_bytes_used + nbytes > self.hot_bytes:
                 self._hot_gauge.set(self._hot_bytes_used)
                 ok = False
             else:
                 self._hot[key] = table
                 self._hot_bytes_used += nbytes
+                self._key_tenant[key] = tenant_id
+                self._tenant_hot_bytes[tenant_id] = \
+                    self._tenant_hot_bytes.get(tenant_id, 0) + nbytes
                 self._hot_gauge.set(self._hot_bytes_used)
                 ok = True
-        for _ in evicted:
+            touched = {tenant_id} | {t for _, t in evicted}
+            tenant_bytes = {t: self._tenant_hot_bytes.get(t, 0)
+                            for t in touched}
+        for _, victim_tenant in evicted:
             # Demotion, not loss: put() wrote the entry through to disk,
             # so the evicted key keeps serving from the next tier down.
             self._hot_evictions.inc()
+            self._tenant_counters_for(victim_tenant)[2].inc()
+        for t, used in tenant_bytes.items():
+            rt_metrics.gauge("rsdl_tenant_cache_bytes",
+                             tenant=t).set(used)
         return ok
 
     # -- prefetch seam -------------------------------------------------
@@ -491,6 +586,21 @@ class TieredStore:
             if key in self._hot:
                 return True
         return self.disk is not None and key in self.disk
+
+    def resident_bytes(self, key: str) -> int:
+        """Size of ``key``'s resident copy (hot table bytes, else the
+        disk entry's on-disk bytes, else 0) — prefetch quota
+        accounting."""
+        with self._lock:
+            table = self._hot.get(key)
+            if table is not None:
+                return table.nbytes
+        if self.disk is not None:
+            with self.disk._lock:
+                entry = self.disk._paths.get(key)
+                if entry is not None:
+                    return entry[1]
+        return 0
 
     def warm(self, key: str) -> bool:
         """Fetch + decode + transform + insert ``key`` so a later map
@@ -544,4 +654,10 @@ class TieredStore:
             PrefetchManager
         files = [node.meta["file"] for node in plan.maps()
                  if node.meta.get("file")]
-        return PrefetchManager(self, files)
+        # Pin the tenant at construction: the plan's tenant_id if it
+        # carries one, else whoever is building the prefetcher — pool
+        # threads running the tasks later may sit in a different
+        # ambient scope.
+        tenant = getattr(plan, "tenant_id", None) \
+            or rt_tenancy.current_tenant()
+        return PrefetchManager(self, files, tenant=tenant)

@@ -32,6 +32,7 @@ import threading
 from collections import deque
 from typing import Optional
 
+from ray_shuffling_data_loader_tpu import tenancy as rt_tenancy
 from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
 from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 
@@ -62,10 +63,18 @@ class PrefetchTask:
         already was) resident."""
         if self._cancel.is_set():
             return False
+        if not self.manager._under_quota():
+            # Quota throttle, not cancellation: the tenant spent its
+            # prefetch byte allowance; the real read path still fetches
+            # on demand, this lane just stops speculating for it.
+            return False
         self._started.set()
         self.manager._issued.inc()
         try:
-            return self.manager.store.warm(self.path)
+            warmed = self.manager.store.warm(self.path)
+            if warmed:
+                self.manager._charge(self.path)
+            return warmed
         except Exception as e:  # noqa: BLE001 - optimization, not truth
             logger.debug("prefetch of %s failed (%s); the real read "
                          "path will fetch it", self.path, e)
@@ -76,8 +85,15 @@ class PrefetchManager:
     """Hands the scheduler one :class:`PrefetchTask` at a time, in plan
     order, skipping files already resident in the store."""
 
-    def __init__(self, store, files):
+    def __init__(self, store, files, tenant=None):
         self.store = store
+        # The owning tenant (ambient unless pinned): its
+        # prefetch_quota_bytes caps how many bytes of speculation this
+        # plan may warm — demand reads are never throttled, only the
+        # idle-lane speculation stops once the allowance is spent.
+        self.tenant = rt_tenancy.resolve(tenant)
+        self._quota = self.tenant.prefetch_quota_bytes
+        self._warmed_bytes = 0
         self._pending = deque(files)
         self._lock = threading.Lock()
         self._issued = rt_metrics.counter(
@@ -86,6 +102,30 @@ class PrefetchManager:
         self._canceled = rt_metrics.counter(
             "rsdl_storage_prefetch_canceled_total",
             "prefetch tasks reclaimed by real work before starting")
+        self._throttled = rt_metrics.counter(
+            "rsdl_tenant_prefetch_throttled_total",
+            "prefetch tasks skipped by the tenant's byte quota",
+            tenant=self.tenant.tenant_id)
+
+    def _under_quota(self) -> bool:
+        if self._quota is None:
+            return True
+        with self._lock:
+            ok = self._warmed_bytes < self._quota
+        if not ok:
+            self._throttled.inc()
+        return ok
+
+    def _charge(self, path: str) -> None:
+        sizer = getattr(self.store, "resident_bytes", None)
+        if sizer is None:
+            return
+        try:
+            nbytes = int(sizer(path))
+        except Exception:  # noqa: BLE001 - accounting only
+            return
+        with self._lock:
+            self._warmed_bytes += nbytes
 
     def next(self) -> Optional[PrefetchTask]:
         """The next non-resident file as a task; None when drained.

@@ -27,11 +27,14 @@ awareness at all.
 
 from __future__ import annotations
 
+import contextlib
+import dataclasses
 import importlib
 import timeit
 from typing import Any, Callable, Dict, Optional
 
 from ray_shuffling_data_loader_tpu import executor as ex
+from ray_shuffling_data_loader_tpu import tenancy as rt_tenancy
 from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
 from ray_shuffling_data_loader_tpu.streaming import window as win
 from ray_shuffling_data_loader_tpu.streaming.source import StreamSource
@@ -80,8 +83,15 @@ class StreamingShuffleRunner:
                  num_workers: Optional[int] = None,
                  max_windows: Optional[int] = None,
                  clock_step_s: Optional[float] = None,
-                 on_window_served: Optional[Callable[[int], None]] = None):
+                 on_window_served: Optional[Callable[[int], None]] = None,
+                 tenant=None):
         from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+        # The stream's owning tenant: every window spec this runner
+        # emits is stamped with its id (plan IR threading) and the
+        # whole drive runs under its tenant_scope so storage-plane
+        # attribution lands on the right ledger. None = ambient.
+        self.tenant = (rt_tenancy.resolve(tenant)
+                       if tenant is not None else None)
         self.source = source
         self.batch_consumer = batch_consumer
         self.num_reducers = num_reducers
@@ -148,6 +158,9 @@ class StreamingShuffleRunner:
         for spec in self.assembler.specs(self.source,
                                          max_windows=self.max_windows,
                                          clock_step_s=self.clock_step_s):
+            if self.tenant is not None and spec.tenant_id is None:
+                spec = dataclasses.replace(
+                    spec, tenant_id=self.tenant.tenant_id)
             if spec.window is not None:
                 self._window_meta[spec.epoch] = dict(spec.window)
             self._observe_lag()
@@ -188,13 +201,17 @@ class StreamingShuffleRunner:
         sh = _shuffle_mod()
         start = timeit.default_timer()
         self._skip_sealed_prefix()
-        duration = sh.shuffle_epochs(
-            self._specs(), self.batch_consumer, self.num_reducers,
-            self.num_trainers,
-            max_concurrent_epochs=self.max_concurrent_epochs,
-            seed=self.seed, num_workers=self.num_workers,
-            file_cache=None, epochs_hint=None,
-            on_epoch_done=self._on_epoch_done)
+        scope = (rt_tenancy.tenant_scope(self.tenant)
+                 if self.tenant is not None
+                 else contextlib.nullcontext())
+        with scope:
+            duration = sh.shuffle_epochs(
+                self._specs(), self.batch_consumer, self.num_reducers,
+                self.num_trainers,
+                max_concurrent_epochs=self.max_concurrent_epochs,
+                seed=self.seed, num_workers=self.num_workers,
+                file_cache=None, epochs_hint=None,
+                on_epoch_done=self._on_epoch_done)
         return {
             "duration_s": timeit.default_timer() - start,
             "shuffle_s": duration,
@@ -234,6 +251,7 @@ def server_config(source: StreamSource,
                   max_windows: Optional[int] = None,
                   max_concurrent_epochs: int = 2,
                   ingest_journal_path: Optional[str] = None,
+                  tenant_id: Optional[str] = None,
                   **extra: Any) -> Dict[str, Any]:
     """Build the supervised queue-server config for a BOUNDED stream:
     drain ``source`` into a frozen window schedule (journaling ingest
@@ -249,6 +267,9 @@ def server_config(source: StreamSource,
                                 max_windows=max_windows, journal=journal)
     if journal is not None:
         journal.close()
+    if tenant_id is not None:
+        specs = [dataclasses.replace(s, tenant_id=tenant_id)
+                 if s.tenant_id is None else s for s in specs]
     config = {
         "epochs": win.specs_to_dicts(specs),
         "num_trainers": int(num_trainers),

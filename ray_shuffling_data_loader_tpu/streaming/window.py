@@ -331,9 +331,16 @@ def freeze_schedule(source: StreamSource,
 
 def specs_to_dicts(specs: List[plan_ir.EpochSpec]) -> List[Dict[str, Any]]:
     """JSON-safe form of a frozen schedule (the supervised-child config
-    block)."""
-    return [{"epoch": s.epoch, "filenames": list(s.filenames),
-             "window": s.window} for s in specs]
+    block). ``tenant_id`` rides along only when set — pre-tenancy
+    configs stay byte-identical (the EpochPlan.to_dict contract)."""
+    out = []
+    for s in specs:
+        d = {"epoch": s.epoch, "filenames": list(s.filenames),
+             "window": s.window}
+        if s.tenant_id is not None:
+            d["tenant_id"] = s.tenant_id
+        out.append(d)
+    return out
 
 
 def specs_from_dicts(data) -> List[plan_ir.EpochSpec]:
@@ -341,5 +348,6 @@ def specs_from_dicts(data) -> List[plan_ir.EpochSpec]:
                 epoch=int(d["epoch"]),
                 filenames=tuple(str(f) for f in d["filenames"]),
                 window=(dict(d["window"])
-                        if d.get("window") is not None else None))
+                        if d.get("window") is not None else None),
+                tenant_id=d.get("tenant_id"))
             for d in data]

@@ -573,6 +573,29 @@ STATIC_EPOCH_OK = """
         return current
 """
 
+TENANT_BYPASS_BAD = """
+    def register(self, kind, name, nbytes):
+        # A shared-plane entry point admitting work with no idea whose
+        # work it is: lands on the default ledger, dodges fair-share.
+        self._ledger[name] = nbytes
+        return True
+"""
+
+TENANT_BYPASS_PARAM_OK = """
+    def register(self, tenant, kind, name, nbytes):
+        self._ledger[(tenant.tenant_id, name)] = nbytes
+        return True
+"""
+
+TENANT_BYPASS_AMBIENT_OK = """
+    from ray_shuffling_data_loader_tpu import tenancy
+
+    def register(self, kind, name, nbytes):
+        ctx = tenancy.current_tenant()
+        self._ledger[(ctx.tenant_id, name)] = nbytes
+        return True
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -616,7 +639,27 @@ CASES = [
     ("static-epoch-assumption", STATIC_EPOCH_SUBSCRIPT_BAD,
      STATIC_EPOCH_OK,
      {"path": "ray_shuffling_data_loader_tpu/multiqueue_service.py"}),
+    ("tenant-context-bypass", TENANT_BYPASS_BAD, TENANT_BYPASS_PARAM_OK,
+     {"path": "ray_shuffling_data_loader_tpu/storage/remote.py"}),
+    ("tenant-context-bypass", TENANT_BYPASS_BAD, TENANT_BYPASS_AMBIENT_OK,
+     {"path": "ray_shuffling_data_loader_tpu/multiqueue_service.py"}),
 ]
+
+
+def test_tenant_bypass_scoped_to_shared_planes():
+    """Only the serving/storage planes' entry points must be
+    tenant-aware; a `register` helper elsewhere (a metrics registry, a
+    test fixture) is not an admission point and never flags. Nor does a
+    non-entry-point function inside a covered file."""
+    for exempt in ("pkg/mod.py", "tests/test_x.py",
+                   "ray_shuffling_data_loader_tpu/runtime/metrics.py"):
+        flagged, _ = lint(TENANT_BYPASS_BAD, path=exempt)
+        assert "tenant-context-bypass" not in flagged, exempt
+    flagged, _ = lint("""
+        def helper(self, name, nbytes):
+            self._ledger[name] = nbytes
+    """, path="ray_shuffling_data_loader_tpu/storage/remote.py")
+    assert "tenant-context-bypass" not in flagged
 
 
 def test_lineage_outside_plan_scoped_to_library_code():

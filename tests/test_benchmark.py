@@ -163,6 +163,50 @@ def test_bench_py_phase_subset(tmp_path):
     assert record["cache_mode"] == "cold"
 
 
+def test_bench_py_tenancy_phase_contract(tmp_path):
+    """A tenancy-only bench run (the CI contention leg in dryrun scale)
+    exits 0 and reports the structural tenancy keys: fairness ratio,
+    per-tenant rates, p99s and the journaled admission evidence. The
+    pass/fail verdict (tenancy_ok) is NOT asserted — at smoke scale
+    the ratios are scheduler-noise-bound; the nightly leg at full
+    scale plus rsdl_bench_diff gate the actual values."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    env = dict(os.environ)
+    env.update(RSDL_BENCH_CPU="1", RSDL_BENCH_ROWS="20000",
+               RSDL_BENCH_FILES="2", RSDL_BENCH_EPOCHS="2",
+               RSDL_BENCH_BATCH="2048", RSDL_BENCH_PHASES="tenancy",
+               RSDL_BENCH_TENANCY_REDUCERS="8",
+               RSDL_BENCH_TENANCY_EPOCHS="1",
+               RSDL_BENCH_DATA=str(tmp_path / "data"))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py")],
+        capture_output=True, text=True, timeout=300, env=env, cwd=repo)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    record = json.loads([l for l in proc.stdout.splitlines()
+                         if l.startswith("{")][0])
+    assert record["metric"] == "tenancy_hot_rows_per_sec"
+    for key in ("tenancy_weight_ratio", "tenancy_fairness_ratio",
+                "tenancy_hot_rows", "tenancy_cold_rows_at_hot_finish",
+                "tenancy_hot_rows_per_sec", "tenancy_cold_rows_per_sec",
+                "tenancy_solo_rows_per_sec", "tenancy_hot_slo_p99_ms",
+                "tenancy_admitted", "tenancy_rejected",
+                "tenancy_admission_replay_ok", "tenancy_ok"):
+        assert key in record, key
+    assert record["tenancy_weight_ratio"] == 3.0
+    assert record["tenancy_hot_rows"] > 0
+    assert record["tenancy_fairness_ratio"] > 0
+    # The admission evidence is deterministic at ANY scale: two
+    # accepts, one oversized reject, and a bit-identical replay.
+    assert record["tenancy_admitted"] == 2
+    assert record["tenancy_rejected"] == 1
+    assert record["tenancy_admission_replay_ok"] is True
+
+
 def test_run_ingest_phase_dict_contract(tmp_path):
     """run_ingest returns the phase-dict fields main() assembles into the
     JSON record, for both clock modes (cached: from first delivery;

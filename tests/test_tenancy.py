@@ -1,0 +1,354 @@
+"""Tests for the tenancy package: identity (tenancy/__init__.py),
+weighted-fair scheduling (tenancy/fairshare.py) and journaled
+admission control (tenancy/admission.py)."""
+
+import json
+import threading
+
+import pytest
+
+from ray_shuffling_data_loader_tpu import tenancy as rt_tenancy
+from ray_shuffling_data_loader_tpu.tenancy import admission as rt_adm
+from ray_shuffling_data_loader_tpu.tenancy import fairshare as rt_fair
+from ray_shuffling_data_loader_tpu.tenancy import (
+    DEFAULT_TENANT_ID, TenantContext, current_tenant, tenant_scope)
+
+
+# ---------------------------------------------------------------------------
+# identity
+# ---------------------------------------------------------------------------
+
+class TestTenantContext:
+
+    def test_defaults_change_nothing(self):
+        ctx = TenantContext("team-a")
+        assert ctx.priority == "standard"
+        assert ctx.weight is None
+        assert ctx.effective_weight == rt_tenancy.PRIORITY_WEIGHTS["standard"]
+        assert ctx.cache_quota_bytes is None
+        assert ctx.byte_quota is None
+
+    @pytest.mark.parametrize("bad", ["", "UPPER", "has space", "-lead",
+                                     "a" * 65, 7, None])
+    def test_invalid_ids_rejected(self, bad):
+        with pytest.raises((ValueError, TypeError)):
+            TenantContext(bad)
+
+    def test_invalid_priority_rejected(self):
+        with pytest.raises(ValueError, match="priority"):
+            TenantContext("t", priority="urgent")
+
+    def test_invalid_weight_rejected(self):
+        with pytest.raises(ValueError, match="weight"):
+            TenantContext("t", weight=0.0)
+
+    def test_explicit_weight_wins_over_priority(self):
+        ctx = TenantContext("t", priority="batch", weight=7.5)
+        assert ctx.effective_weight == 7.5
+
+    def test_json_round_trip_is_canonical(self):
+        ctx = TenantContext("hot", priority="interactive", weight=3.0,
+                            byte_quota=1 << 20, slo_p99_ms=50.0)
+        blob = ctx.to_json()
+        # canonical form: sorted keys, compact separators, None omitted
+        d = json.loads(blob)
+        assert list(d) == sorted(d)
+        assert "cache_quota_bytes" not in d
+        assert TenantContext.from_json(blob) == ctx
+        assert TenantContext.from_json(blob).to_json() == blob
+
+    def test_from_dict_ignores_unknown_keys(self):
+        ctx = TenantContext.from_dict(
+            {"tenant_id": "t", "priority": "batch", "future_field": 1})
+        assert ctx.tenant_id == "t"
+
+    def test_resolve_forms(self):
+        ctx = TenantContext("named")
+        assert rt_tenancy.resolve(ctx) is ctx
+        assert rt_tenancy.resolve("named") == ctx
+        assert rt_tenancy.resolve({"tenant_id": "named"}) == ctx
+        assert rt_tenancy.resolve(None).tenant_id == DEFAULT_TENANT_ID
+        with pytest.raises(TypeError):
+            rt_tenancy.resolve(42)
+
+    def test_scope_is_ambient_and_nests(self):
+        assert current_tenant().tenant_id == DEFAULT_TENANT_ID
+        outer = TenantContext("outer")
+        inner = TenantContext("inner")
+        with tenant_scope(outer):
+            assert current_tenant() is outer
+            assert rt_tenancy.resolve(None) is outer
+            with tenant_scope(inner):
+                assert current_tenant() is inner
+            assert current_tenant() is outer
+        assert current_tenant().tenant_id == DEFAULT_TENANT_ID
+
+    def test_scope_is_per_thread(self):
+        seen = {}
+
+        def probe():
+            seen["thread"] = current_tenant().tenant_id
+
+        with tenant_scope(TenantContext("main-only")):
+            t = threading.Thread(target=probe)
+            t.start()
+            t.join()
+        assert seen["thread"] == DEFAULT_TENANT_ID
+
+    def test_tenants_from_config_fills_weights(self):
+        cfg = rt_tenancy.tenants_from_config({
+            "a": {"priority": "interactive", "ranks": [0]},
+            "b": {"weight": 2.5},
+            "c": None,
+        })
+        assert cfg["a"]["weight"] == \
+            rt_tenancy.PRIORITY_WEIGHTS["interactive"]
+        assert cfg["a"]["ranks"] == [0]
+        assert cfg["b"]["weight"] == 2.5
+        assert cfg["c"]["weight"] == \
+            rt_tenancy.PRIORITY_WEIGHTS["standard"]
+        with pytest.raises(ValueError):
+            rt_tenancy.tenants_from_config({"bad id": {}})
+        with pytest.raises(ValueError):
+            rt_tenancy.tenants_from_config({"t": {"weight": -1}})
+
+
+# ---------------------------------------------------------------------------
+# weighted fair share
+# ---------------------------------------------------------------------------
+
+def make_fair(weights, clock, **kw):
+    kw.setdefault("total_budget", 1 << 24)
+    kw.setdefault("quantum_bytes", 1 << 18)
+    return rt_fair.FairShare(weights, clock=lambda: clock[0], **kw)
+
+
+class TestFairShare:
+
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            rt_fair.FairShare({"t": 1.0}, total_budget=0)
+        with pytest.raises(ValueError):
+            rt_fair.FairShare({"t": 0.0}, total_budget=1)
+        fair = rt_fair.FairShare({}, total_budget=1)
+        with pytest.raises(ValueError):
+            fair.set_weight("t", -1.0)
+
+    def test_lone_tenant_gets_whole_budget(self):
+        clock = [0.0]
+        fair = make_fair({"solo": 3.0}, clock)
+        fair.touch("solo")
+        assert fair.budget("solo") == fair.total_budget
+
+    def test_budget_partitions_by_weight(self):
+        clock = [0.0]
+        fair = make_fair({"hot": 3.0, "cold": 1.0}, clock)
+        fair.touch("hot")
+        fair.touch("cold")
+        assert fair.budget("hot") == int(fair.total_budget * 3 / 4)
+        assert fair.budget("cold") == int(fair.total_budget * 1 / 4)
+
+    def test_budget_redistributes_after_window(self):
+        clock = [0.0]
+        fair = make_fair({"hot": 3.0, "cold": 1.0}, clock,
+                         active_window_s=0.05)
+        fair.touch("hot")
+        fair.touch("cold")
+        assert fair.budget("hot") < fair.total_budget
+        clock[0] += 0.2  # cold goes quiet past the window
+        fair.touch("hot")
+        assert fair.budget("hot") == fair.total_budget
+
+    def test_unknown_tenant_uses_default_weight(self):
+        clock = [0.0]
+        fair = make_fair({"known": 3.0}, clock, default_weight=1.0)
+        assert fair.weight("stranger") == 1.0
+        fair.set_weight("stranger", 2.0)
+        assert fair.weight("stranger") == 2.0
+
+    def test_drr_converges_to_weight_ratio(self):
+        # The ISSUE's acceptance bound: 3:1 weights -> delivered bytes
+        # within +-15% of 3:1 under saturating demand.
+        clock = [0.0]
+        fair = make_fair({"hot": 3.0, "cold": 1.0}, clock)
+        delivered = rt_fair.simulate_rounds(
+            fair, {"hot": 1 << 30, "cold": 1 << 30},
+            frame_bytes=1 << 14, rounds=200,
+            advance=lambda: clock.__setitem__(0, clock[0] + 0.01))
+        ratio = delivered["hot"] / delivered["cold"]
+        assert abs(ratio / 3.0 - 1.0) <= 0.15, ratio
+
+    def test_drr_equal_weights_equal_service(self):
+        clock = [0.0]
+        fair = make_fair({"a": 1.0, "b": 1.0}, clock)
+        delivered = rt_fair.simulate_rounds(
+            fair, {"a": 1 << 30, "b": 1 << 30},
+            frame_bytes=1 << 14, rounds=200,
+            advance=lambda: clock.__setitem__(0, clock[0] + 0.01))
+        ratio = delivered["a"] / delivered["b"]
+        assert abs(ratio - 1.0) <= 0.15, ratio
+
+    def test_work_conserving_when_competitor_leaves(self):
+        # A tenant alone on the link is never denied, whatever its
+        # weight — fairness shapes ratios, it must not cap a lone flow.
+        clock = [0.0]
+        fair = make_fair({"hot": 3.0, "cold": 1.0}, clock,
+                         active_window_s=0.05)
+        fair.touch("hot")
+        fair.touch("cold")
+        clock[0] += 0.2  # hot leaves
+        fair.touch("cold")
+        for _ in range(64):  # many quanta worth: always replenished
+            assert fair.grant("cold")
+            fair.charge("cold", fair.quantum_bytes)
+
+    def test_idle_drops_claim_and_credit(self):
+        clock = [0.0]
+        fair = make_fair({"hot": 3.0, "cold": 1.0}, clock)
+        fair.touch("hot")
+        fair.touch("cold")
+        assert fair.deficit("hot") > 0
+        fair.idle("hot")
+        assert fair.deficit("hot") == 0.0
+        assert "hot" not in list(fair.active())
+        # cold no longer waits on hot's unspent credit: replenish works
+        fair.charge("cold", fair.deficit("cold") + 1)
+        assert fair.grant("cold")
+        # hot rejoins like a fresh flow, with one quantum of credit
+        fair.touch("hot")
+        assert fair.deficit("hot") == \
+            pytest.approx(fair.quantum_bytes * 3.0)
+
+    def test_grant_blocks_while_others_hold_credit(self):
+        clock = [0.0]
+        fair = make_fair({"hot": 3.0, "cold": 1.0}, clock)
+        fair.touch("hot")
+        fair.touch("cold")
+        # cold burns its credit; hot still holds some -> cold must wait
+        fair.charge("cold", fair.deficit("cold") + 1)
+        assert not fair.grant("cold")
+        # hot burns its credit too -> the round ends, all replenish
+        fair.charge("hot", fair.deficit("hot") + 1)
+        assert fair.grant("cold")
+        assert fair.deficit("hot") > 0
+
+    def test_snapshot_shape(self):
+        clock = [0.0]
+        fair = make_fair({"hot": 3.0}, clock)
+        fair.touch("hot")
+        snap = fair.snapshot()
+        assert snap["hot"]["active"] is True
+        assert snap["hot"]["weight"] == 3.0
+        assert snap["hot"]["budget"] == fair.total_budget
+
+
+# ---------------------------------------------------------------------------
+# admission control
+# ---------------------------------------------------------------------------
+
+class TestAdmission:
+
+    def test_accept_within_capacity(self):
+        ctl = rt_adm.AdmissionController(capacity_bytes=1000)
+        d = ctl.register(TenantContext("t"), "dataset", "d1", 600)
+        assert d.action == "accept"
+        assert ctl.ledger.used_bytes == 600
+
+    def test_reject_over_cluster_capacity(self):
+        ctl = rt_adm.AdmissionController(capacity_bytes=1000)
+        d = ctl.register(TenantContext("t"), "dataset", "huge", 5000)
+        assert d.action == "reject"
+        assert "capacity" in d.reason
+        assert ctl.ledger.used_bytes == 0
+
+    def test_reject_over_tenant_quota(self):
+        ctl = rt_adm.AdmissionController(capacity_bytes=10_000)
+        greedy = TenantContext("greedy", byte_quota=500)
+        assert ctl.register(greedy, "dataset", "a", 400).action == "accept"
+        d = ctl.register(greedy, "dataset", "b", 400)
+        assert d.action == "reject"
+        assert "quota" in d.reason
+
+    def test_queue_then_admit_fifo_on_release(self):
+        ctl = rt_adm.AdmissionController(capacity_bytes=1000)
+        t = TenantContext("t")
+        assert ctl.register(t, "dataset", "live", 900).action == "accept"
+        assert ctl.register(t, "stream", "w1", 800).action == "queue"
+        assert ctl.register(t, "stream", "w2", 150).action == "queue"
+        assert ctl.waiting() == 2
+        out = ctl.release("t", "live")
+        # FIFO: w1 admits first and w2 fits behind it
+        assert [d.action for d in out] == ["release", "admit", "admit"]
+        assert [d.name for d in out] == ["live", "w1", "w2"]
+        assert ctl.waiting() == 0
+        assert ctl.ledger.used_bytes == 950
+
+    def test_fifo_head_of_line_blocks(self):
+        ctl = rt_adm.AdmissionController(capacity_bytes=1000)
+        t = TenantContext("t")
+        ctl.register(t, "dataset", "live", 900)
+        ctl.register(t, "dataset", "big", 950)     # queued, head of line
+        ctl.register(t, "dataset", "small", 200)   # queued behind it
+        out = ctl.release("t", "live")
+        # The release frees 900: big (head) admits, then small does not
+        # fit behind it and stays queued — the head is never skipped.
+        assert [d.action for d in out] == ["release", "admit"]
+        assert out[1].name == "big"
+        assert ctl.waiting() == 1
+
+    def test_invalid_kind_rejected(self):
+        ctl = rt_adm.AdmissionController(capacity_bytes=1000)
+        with pytest.raises(ValueError, match="kind"):
+            ctl.register(TenantContext("t"), "table", "x", 1)
+
+    def test_journal_replays_bit_identically(self, tmp_path):
+        journal = str(tmp_path / "admission.journal")
+        ctl = rt_adm.AdmissionController(capacity_bytes=1000,
+                                         journal_path=journal)
+        hot = TenantContext("hot", priority="interactive", weight=3.0)
+        cold = TenantContext("cold", priority="batch",
+                             byte_quota=700)
+        ctl.register(hot, "stream", "live", 600)
+        ctl.register(cold, "dataset", "replay", 600)   # queue
+        ctl.register(cold, "dataset", "oversize", 800)  # reject (quota)
+        ctl.release("hot", "live")                     # admits replay
+        ctl.close()
+        with open(journal, "rb") as f:
+            original = f.read()
+        rebuilt = rt_adm.replay(journal, capacity_bytes=1000,
+                                tenants={"hot": hot, "cold": cold})
+        assert rebuilt.journal_bytes() == original
+        assert rebuilt.ledger.used_bytes == ctl.ledger.used_bytes
+        assert rebuilt.ledger.tenant_bytes("cold") == 600
+
+    def test_replay_divergence_raises(self, tmp_path):
+        journal = str(tmp_path / "admission.journal")
+        ctl = rt_adm.AdmissionController(capacity_bytes=1000,
+                                         journal_path=journal)
+        quota = TenantContext("q", byte_quota=500)
+        ctl.register(quota, "dataset", "a", 400)
+        ctl.register(quota, "dataset", "b", 400)  # reject under quota
+        ctl.close()
+        # Replaying WITHOUT the tenant's quota context re-derives an
+        # accept where the journal says reject -> version-skew guard.
+        with pytest.raises(ValueError, match="diverged"):
+            rt_adm.replay(journal, capacity_bytes=1000)
+
+    def test_replay_detects_tampered_journal(self, tmp_path):
+        journal = str(tmp_path / "admission.journal")
+        ctl = rt_adm.AdmissionController(capacity_bytes=1000,
+                                         journal_path=journal)
+        ctl.register(TenantContext("t"), "dataset", "a", 400)
+        ctl.close()
+        with open(journal, "ab") as f:
+            f.write(b'{"forged":1}\n')
+        with pytest.raises((ValueError, TypeError)):
+            rt_adm.replay(journal, capacity_bytes=1000)
+
+    def test_decision_line_is_canonical(self):
+        d = rt_adm.AdmissionDecision(1, "accept", "t", "dataset", "x", 5)
+        line = d.to_line()
+        assert line.endswith(b"\n")
+        parsed = json.loads(line)
+        assert list(parsed) == sorted(parsed)
+        assert rt_adm.AdmissionDecision.from_line(line) == d

@@ -79,12 +79,15 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
     # the simulated object store, and the prefetch A/B speedup itself —
     # a prefetch regression (lanes never idle, warms the wrong files,
     # cancels everything) can hide behind a faster host's absolute
-    # rows/s while the on-vs-off ratio collapses toward 1.0. The sim's
-    # sleeps are deterministic but thread scheduling is not, so the
-    # thresholds are wide.
-    {"key": "remote_rows_per_sec", "mode": "lower_bad", "pct": 20.0},
+    # rows/s while the on-vs-off ratio collapses toward 1.0.
+    # Tightened from 20/25 after the r08->r09 drift (-17.1% rows/s,
+    # -13.5% speedup — the streaming PR's shuffled map-read order halved
+    # prefetch efficiency, see examples/performance.md) sailed UNDER the
+    # old thresholds: both metrics now fail the diff well before a
+    # regression of that size lands silently again.
+    {"key": "remote_rows_per_sec", "mode": "lower_bad", "pct": 10.0},
     {"key": "remote_prefetch_speedup_x", "mode": "lower_bad",
-     "pct": 25.0},
+     "pct": 8.0},
     # Streaming leg (streaming/): windowed end-to-end rate over the
     # synthetic stream, the pipelining watermark lag (stream seconds —
     # deterministic arrivals, so a lag jump means the assembler or the
@@ -96,6 +99,15 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
      "slack": 5.0},
     {"key": "window_close_ms", "mode": "higher_bad", "pct": 150.0,
      "slack": 200.0},
+    # Tenancy contention leg (tenancy/): the hot:cold delivered-rows
+    # ratio under a 3:1 weight split must track the weights (fairness
+    # physics, not host speed — wide but meaningful), and the hot
+    # tenant's contended p99 must stay within its solo multiple.
+    # Records older than r10 lack these keys; relative rules skip.
+    {"key": "tenancy_fairness_ratio", "mode": "lower_bad", "pct": 25.0},
+    {"key": "tenancy_hot_rows_per_sec", "mode": "lower_bad", "pct": 20.0},
+    {"key": "tenancy_latency_ratio_x", "mode": "higher_bad", "pct": 50.0,
+     "slack": 0.5},
 ]
 
 

@@ -410,6 +410,41 @@ def _section_scaling(records) -> str:
     return "".join(parts)
 
 
+def _section_tenancy(records) -> str:
+    """Per-tenant QoS columns from the contention bench leg (bench.py
+    ``tenancy`` phase): fairness ratio against the configured weight
+    split, per-tenant throughput, and the hot tenant's contended-vs-
+    solo p99 multiple. Skips cleanly for records predating the leg."""
+    rows = []
+    for r, rec in records:
+        if rec.get("tenancy_fairness_ratio") is None:
+            continue
+        rows.append((
+            f"r{r:02d}",
+            _fmt(rec.get("tenancy_fairness_ratio")),
+            _fmt(rec.get("tenancy_weight_ratio")),
+            _fmt(rec.get("tenancy_hot_rows_per_sec")),
+            _fmt(rec.get("tenancy_cold_rows_per_sec")),
+            _fmt(rec.get("tenancy_hot_p99_ms_solo")),
+            _fmt(rec.get("tenancy_hot_p99_ms_contended")),
+            _fmt(rec.get("tenancy_latency_ratio_x")),
+            ("ok" if rec.get("tenancy_ok") else
+             "<span class='breach'>FAIL</span>")
+            if rec.get("tenancy_ok") is not None else "–",
+        ))
+    if not rows:
+        return ""
+    return "".join([
+        "<h2>Tenancy contention</h2>",
+        "<p class='sub'>hot streaming tenant vs cold batch-replay "
+        "tenant on shared shards — delivered-rows ratio should track "
+        "the weight split, hot p99 should hold near solo</p>",
+        _table(("round", "fairness ratio", "weights", "hot rows/s",
+                "cold rows/s", "hot p99 solo ms", "hot p99 cont ms",
+                "p99 ratio", "ok"), rows),
+    ])
+
+
 def build_html(records, ring, traced, manifest) -> str:
     latest = records[-1][1] if records else {}
     sub = []
@@ -425,6 +460,7 @@ def build_html(records, ring, traced, manifest) -> str:
         + _section_history(ring)
         + _section_traces(traced)
         + _section_scaling(records)
+        + _section_tenancy(records)
         + _section_bench(records))
     return ("<!DOCTYPE html><html><head><meta charset='utf-8'>"
             "<title>rsdl run report</title>"

@@ -266,6 +266,62 @@ def render_streaming(parsed: dict) -> list:
     return [line]
 
 
+def render_tenants(parsed: dict) -> list:
+    """Per-tenant QoS lines (tenancy/): delivered bytes, in-flight
+    replay vs fair-share budget, cache residency vs quota, prefetch
+    throttles, and the delivery-latency sketch's p50/p99 — the "is the
+    weighted-fair scheduler actually honoring the weights" view.
+    Silent in single-tenant deployments (no tenant series minted)."""
+    delivered = _by_label(parsed, "rsdl_tenant_bytes_delivered_total",
+                          "tenant")
+    replay = _by_label(parsed, "rsdl_tenant_replay_bytes", "tenant")
+    budget = _by_label(parsed, "rsdl_tenant_budget_bytes", "tenant")
+    cache = _by_label(parsed, "rsdl_tenant_cache_bytes", "tenant")
+    quota = _by_label(parsed, "rsdl_tenant_cache_quota_bytes", "tenant")
+    throttled = _by_label(parsed, "rsdl_tenant_prefetch_throttled_total",
+                          "tenant")
+    tenants = sorted(set(delivered) | set(replay) | set(cache))
+    if not tenants:
+        return []
+    sketch = parsed.get("rsdl_tenant_delivery_latency_seconds_centroid", {})
+    stats = _metrics.sketch_quantiles(
+        {"rsdl_tenant_delivery_latency_seconds_centroid": sketch},
+        "rsdl_tenant_delivery_latency_seconds",
+        hop="queued_to_delivered") if sketch else {}
+    by_tenant_lat = {}
+    for labels, entry in stats.items():
+        tenant = dict(labels).get("tenant")
+        if tenant is not None:
+            by_tenant_lat[tenant] = entry
+    lines = ["tenants:"]
+    for tenant in tenants:
+        line = (f"  {tenant:<12} "
+                f"delivered {_human_bytes(delivered.get(tenant, 0.0)):>10}")
+        if tenant in budget:
+            line += (f"  inflight {_human_bytes(replay.get(tenant, 0.0))}"
+                     f"/{_human_bytes(budget[tenant])}")
+        if tenant in cache:
+            line += f"  cache {_human_bytes(cache[tenant])}"
+            if quota.get(tenant):
+                line += f"/{_human_bytes(quota[tenant])}"
+        if throttled.get(tenant):
+            line += f"  throttled {int(throttled[tenant])}"
+        entry = by_tenant_lat.get(tenant)
+        if entry:
+            line += (f"  p50 {entry['p50'] * 1e3:.1f}ms "
+                     f"p99 {entry['p99'] * 1e3:.1f}ms")
+        lines.append(line)
+    waiting = _scalar(parsed, "rsdl_admission_waiting")
+    used = _scalar(parsed, "rsdl_admission_used_bytes")
+    rejected = sum(v for labels, v in
+                   parsed.get("rsdl_admission_decisions_total", {}).items()
+                   if dict(labels).get("action") == "reject")
+    if waiting or used or rejected:
+        lines.append(f"  admission: {_human_bytes(used)} charged  "
+                     f"{int(waiting)} waiting  {int(rejected)} rejected")
+    return lines
+
+
 def render_latency(parsed: dict, before: dict = None) -> list:
     """Per-queue delivery-latency lines (runtime/latency.py sketch):
     p50/p95/p99 of the end-to-end birth->delivered hop plus the queue's
@@ -416,6 +472,7 @@ def render(parsed: dict, before: dict = None, interval_s: float = None
             f"server restarts: {int(restarts)}")
     lines.extend(render_shards(parsed))
     lines.extend(render_storage(parsed))
+    lines.extend(render_tenants(parsed))
     lines.extend(render_streaming(parsed))
     lines.extend(render_latency(parsed, before=before if rate_mode
                                 else None))
