@@ -429,7 +429,7 @@ class _Frame:
     __slots__ = ("seq", "kind", "epoch", "wire", "crc", "row_offset",
                  "nrows", "task", "codec", "payload_bytes", "data_crc",
                  "handle_path", "ledger_id", "birth", "queued",
-                 "pending_codec")
+                 "pending_codec", "tenant")
 
     def __init__(self, seq, kind, epoch, wire, crc, row_offset, nrows,
                  task=TASK_NONE, codec=CODEC_NONE, payload_bytes=None,
@@ -458,6 +458,11 @@ class _Frame:
         # flight; the frame serves the uncompressed buffer until
         # :meth:`resolve_codec` swaps the result in.
         self.pending_codec = None
+        # The tenant this frame's bytes were CHARGED to at pop time
+        # (set by _collect_frames). Ack/reset credit the same account,
+        # so a rank->tenant rebind between pop and ack cannot strand
+        # the debit on one tenant and land the credit on another.
+        self.tenant = None
 
     def resolve_codec(self) -> int:
         """Finish a deferred codec-pool compression: swap the compressed
@@ -799,12 +804,22 @@ class QueueServer:
                 )
             return counters
 
-    def _charge_tenant(self, queue_idx: int, delta: int) -> None:
+    def _charge_tenant(self, queue_idx: int, delta: int,
+                       tenant_id: Optional[str] = None) -> str:
         """Mirror every replay-byte mutation into the owning tenant's
         ledger (the quantity the fair scheduler partitions). Positive
         deltas also charge the DRR deficit — delivered bytes are what
-        the round-robin meters."""
-        tenant_id = self._tenant_of_queue(queue_idx)
+        the round-robin meters.
+
+        Returns the tenant charged. Pop-time callers pin it on the
+        frame; release paths pass that pinned tenant back, so the
+        credit lands on the account that was debited even when the
+        rank's tenant binding changed in between (an OP_TENANT landing
+        after GETs already charged the default tenant would otherwise
+        drive the new tenant's ledger permanently negative while the
+        old one stays inflated)."""
+        if tenant_id is None:
+            tenant_id = self._tenant_of_queue(queue_idx)
         with self._tenant_lock:
             self._tenant_replay[tenant_id] = \
                 self._tenant_replay.get(tenant_id, 0) + delta
@@ -812,6 +827,7 @@ class QueueServer:
         self._tenant_counters(tenant_id)[1].set(replay)
         if delta > 0 and self._fair is not None:
             self._fair.charge(tenant_id, delta)
+        return tenant_id
 
     def _tenant_may_pop(self, tenant_id: str) -> bool:
         """The weighted-fair gate in the GET pop loop (frames past the
@@ -841,23 +857,32 @@ class QueueServer:
                 UnicodeDecodeError) as e:
             logger.warning("ignoring malformed OP_TENANT blob: %s", e)
             return
+        # The whole bind — known-check, table mutation, FairShare
+        # creation/weight registration — is one critical section: two
+        # concurrent OP_TENANT binds racing here could each observe
+        # ``_fair is None`` and build rival schedulers (losing one
+        # tenant's weight), or one could iterate ``_tenants`` while the
+        # other mutates it. FairShare's own lock is leaf-level, so
+        # taking it (set_weight) under _tenant_lock cannot invert.
         with self._tenant_lock:
             known = ctx.tenant_id in self._tenants
             if not known:
                 self._tenants[ctx.tenant_id] = \
                     {"weight": ctx.effective_weight}
-        if self._fair is None:
-            self._fair = rt_fairshare.FairShare(
-                {t: spec["weight"] for t, spec in self._tenants.items()},
-                int(self._replay_budget),
-                quantum_bytes=int(rt_policy.resolve(
-                    "queue", "tenant_drr_quantum_bytes")),
-                active_window_s=float(rt_policy.resolve(
-                    "queue", "tenant_active_window_s")))
-        elif not known:
-            # The server-side config table wins over a wire-announced
-            # weight for tenants it already names.
-            self._fair.set_weight(ctx.tenant_id, ctx.effective_weight)
+            if self._fair is None:
+                self._fair = rt_fairshare.FairShare(
+                    {t: spec["weight"]
+                     for t, spec in self._tenants.items()},
+                    int(self._replay_budget),
+                    quantum_bytes=int(rt_policy.resolve(
+                        "queue", "tenant_drr_quantum_bytes")),
+                    active_window_s=float(rt_policy.resolve(
+                        "queue", "tenant_active_window_s")))
+            elif not known:
+                # The server-side config table wins over a
+                # wire-announced weight for tenants it already names.
+                self._fair.set_weight(ctx.tenant_id,
+                                      ctx.effective_weight)
         with self._lease_lock:
             if consumer_id is not None:
                 lease = self._leases.get(consumer_id)
@@ -974,13 +999,16 @@ class QueueServer:
         ack release and exactly-once hold unchanged; the CRC is the
         stored segment CRC — the bytes are identical by construction."""
         buf = pp.read_segment_buffer(frame.handle_path)
-        return _Frame(frame.seq, KIND_TABLE, frame.epoch, buf,
-                      frame.data_crc, frame.row_offset, frame.nrows,
-                      frame.task, payload_bytes=frame.payload_bytes,
-                      data_crc=frame.data_crc,
-                      handle_path=frame.handle_path,
-                      ledger_id=frame.ledger_id,
-                      birth=frame.birth, queued=frame.queued)
+        downgraded = _Frame(frame.seq, KIND_TABLE, frame.epoch, buf,
+                            frame.data_crc, frame.row_offset,
+                            frame.nrows, frame.task,
+                            payload_bytes=frame.payload_bytes,
+                            data_crc=frame.data_crc,
+                            handle_path=frame.handle_path,
+                            ledger_id=frame.ledger_id,
+                            birth=frame.birth, queued=frame.queued)
+        downgraded.tenant = frame.tenant
+        return downgraded
 
     def _note_shard_depth(self) -> None:
         if rt_telemetry.stamp():
@@ -995,7 +1023,7 @@ class QueueServer:
         while state.replay and state.replay[0].seq <= ack:
             frame = state.replay.popleft()
             state.replay_bytes -= frame.size
-            self._charge_tenant(queue_idx, -frame.size)
+            self._charge_tenant(queue_idx, -frame.size, frame.tenant)
             self._release_frame(frame)
             state.acked_rows = frame.row_offset + frame.nrows
             if frame.kind == KIND_SENTINEL:
@@ -1120,7 +1148,8 @@ class QueueServer:
                                                  seq, None))
                     state.replay.append(frame)
                     state.replay_bytes += frame.size
-                    self._charge_tenant(queue_idx, frame.size)
+                    frame.tenant = self._charge_tenant(queue_idx,
+                                                       frame.size)
                     frames.append(frame)
             finally:
                 # Land every deferred codec-pool compression before the
@@ -1132,7 +1161,8 @@ class QueueServer:
                         delta = f.resolve_codec()
                         state.replay_bytes += delta
                         if delta:
-                            self._charge_tenant(queue_idx, delta)
+                            self._charge_tenant(queue_idx, delta,
+                                                f.tenant)
                         if delta < 0:
                             self._compression_saved.inc(-delta)
             if frames:
@@ -1428,9 +1458,10 @@ class QueueServer:
             with state.lock:
                 for frame in state.replay:
                     self._release_frame(frame)
+                    # Credit each frame's PINNED tenant (the one charged
+                    # at pop time), not whatever the rank maps to now.
+                    self._charge_tenant(q, -frame.size, frame.tenant)
                 state.replay.clear()
-                if state.replay_bytes:
-                    self._charge_tenant(q, -state.replay_bytes)
                 state.replay_bytes = 0
         while not self._closed.wait(0.2):
             moved = 0

@@ -10,7 +10,8 @@ at all. A registration is one dataset or stream a tenant wants served
 makes a three-way decision:
 
 ``reject``  the ask can NEVER fit (exceeds the tenant's own byte
-            quota or the whole cluster capacity) — telling the tenant
+            quota or the whole cluster capacity, or duplicates a
+            registration already charged/queued) — telling the tenant
             now beats queueing it forever;
 ``queue``   the ask fits in principle but not right now — it waits
             FIFO and is admitted automatically as releases free bytes;
@@ -101,6 +102,9 @@ class QuotaLedger:
 
     def tenant_bytes(self, tenant_id: str) -> int:
         return self._tenant_bytes.get(tenant_id, 0)
+
+    def charged(self, tenant_id: str, name: str) -> bool:
+        return (tenant_id, name) in self._charges
 
     def fits(self, nbytes: int) -> bool:
         if self.max_registrations is not None \
@@ -199,6 +203,19 @@ class AdmissionController:
         if nbytes < 0:
             return AdmissionDecision(seq, "reject", tid, kind, name,
                                      nbytes, "negative byte ask")
+        if self.ledger.charged(tid, name) or any(
+                w[0]["tenant_id"] == tid and w[2] == name
+                for w in self._waiting):
+            # A retry of an already-accepted (or already-queued) ask is
+            # the crash-recovery scenario the journal must survive: it
+            # MUST become a journaled, deterministic decision here. If
+            # it instead escaped to ledger.charge (which raises), the
+            # seq this call already consumed would never be journaled,
+            # and every subsequent replay() of an otherwise-valid
+            # journal would diverge on the gap.
+            return AdmissionDecision(
+                seq, "reject", tid, kind, name, nbytes,
+                "duplicate registration (already charged or queued)")
         if tenant.byte_quota is not None and \
                 self.ledger.tenant_bytes(tid) + nbytes > tenant.byte_quota:
             return AdmissionDecision(

@@ -110,10 +110,18 @@ class FairShare:
         deficit for up to ``active_window_s`` and pin every competing
         batch tenant to the paced liveness floor. It rejoins with a
         fresh quantum on its next :meth:`touch`, like any arriving
-        flow."""
+        flow.
+
+        Only POSITIVE credit is dropped; a negative deficit (debt) is
+        kept, and :meth:`touch` does not re-grant over it. A tenant
+        with one empty stream and one busy replay rank would otherwise
+        zero its debt on every empty-queue GET and rejoin with a fresh
+        quantum on the busy rank's next GET — resetting the round
+        robin each cycle and out-delivering its weight share."""
         with self._lock:
             self._last_active.pop(tenant_id, None)
-            self._deficit.pop(tenant_id, None)
+            if self._deficit.get(tenant_id, 0.0) >= 0:
+                self._deficit.pop(tenant_id, None)
 
     def active(self) -> Iterable[str]:
         """Tenants seen within the activity window (expired ones are

@@ -5,8 +5,11 @@ admission control (tenancy/admission.py)."""
 import json
 import threading
 
+import pyarrow as pa
 import pytest
 
+from ray_shuffling_data_loader_tpu import multiqueue as mq
+from ray_shuffling_data_loader_tpu import multiqueue_service as svc
 from ray_shuffling_data_loader_tpu import tenancy as rt_tenancy
 from ray_shuffling_data_loader_tpu.tenancy import admission as rt_adm
 from ray_shuffling_data_loader_tpu.tenancy import fairshare as rt_fair
@@ -219,6 +222,32 @@ class TestFairShare:
         assert fair.deficit("hot") == \
             pytest.approx(fair.quantum_bytes * 3.0)
 
+    def test_idle_preserves_debt(self):
+        """idle() drops positive credit but keeps DRR debt: a tenant
+        with one empty stream and one busy replay rank must not zero
+        its deficit via empty-queue GETs and re-enter each cycle with
+        a fresh quantum (it would out-deliver its weight share)."""
+        clock = [0.0]
+        fair = make_fair({"hot": 3.0, "cold": 1.0}, clock)
+        fair.touch("hot")
+        fair.touch("cold")
+        # hot overdraws: deficit goes negative (debt)
+        fair.charge("hot", fair.deficit("hot") + 5 * fair.quantum_bytes)
+        debt = fair.deficit("hot")
+        assert debt < 0
+        fair.idle("hot")  # empty-queue GET on hot's idle stream rank
+        assert fair.deficit("hot") == debt  # debt survives
+        # rejoining does NOT re-grant a quantum over standing debt
+        fair.touch("hot")
+        assert fair.deficit("hot") == debt
+        assert not fair.grant("hot")  # cold still holds credit
+        # the debt is repaid by round replenishes, not erased: cold
+        # burns its credit, the round ends, hot replenishes FROM debt
+        fair.charge("cold", fair.deficit("cold") + 1)
+        fair.grant("cold")
+        assert fair.deficit("hot") == pytest.approx(
+            debt + fair.quantum_bytes * 3.0)
+
     def test_grant_blocks_while_others_hold_credit(self):
         clock = [0.0]
         fair = make_fair({"hot": 3.0, "cold": 1.0}, clock)
@@ -301,6 +330,54 @@ class TestAdmission:
         with pytest.raises(ValueError, match="kind"):
             ctl.register(TenantContext("t"), "table", "x", 1)
 
+    def test_duplicate_registration_is_journaled_reject(self):
+        """A retried register (client recovering from a crash) must be
+        a deterministic journaled reject — not a ledger exception that
+        eats a seq and poisons every later replay()."""
+        ctl = rt_adm.AdmissionController(capacity_bytes=1000)
+        assert ctl.register(TenantContext("t"), "dataset", "d1",
+                            300).action == "accept"
+        dup = ctl.register(TenantContext("t"), "dataset", "d1", 300)
+        assert dup.action == "reject"
+        assert "duplicate" in dup.reason
+        # the ledger was charged exactly once
+        assert ctl.ledger.used_bytes == 300
+        # another tenant may reuse the name
+        assert ctl.register(TenantContext("u"), "dataset", "d1",
+                            300).action == "accept"
+
+    def test_duplicate_of_queued_ask_rejected(self):
+        ctl = rt_adm.AdmissionController(capacity_bytes=1000)
+        ctl.register(TenantContext("t"), "dataset", "big", 900)
+        queued = ctl.register(TenantContext("t"), "dataset", "wait", 900)
+        assert queued.action == "queue"
+        dup = ctl.register(TenantContext("t"), "dataset", "wait", 900)
+        assert dup.action == "reject"
+        assert "duplicate" in dup.reason
+        # the release admits the queued ask exactly once
+        ctl.release("t", "big")
+        assert ctl.ledger.used_bytes == 900
+        assert ctl.waiting() == 0
+
+    def test_duplicate_retry_journal_still_replays(self, tmp_path):
+        """The review's repro: accept d1, retry d1, accept d2 — the
+        journal must replay bit-identically (the retry used to consume
+        a seq then raise before journaling, leaving a permanent gap)."""
+        journal = str(tmp_path / "admission.journal")
+        ctl = rt_adm.AdmissionController(capacity_bytes=1000,
+                                         journal_path=journal)
+        t = TenantContext("t")
+        ctl.register(t, "dataset", "d1", 300)
+        ctl.register(t, "dataset", "d1", 300)  # crash-recovery retry
+        ctl.register(t, "dataset", "d2", 300)
+        ctl.close()
+        with open(journal, "rb") as f:
+            original = f.read()
+        rebuilt = rt_adm.replay(journal, capacity_bytes=1000,
+                                tenants={"t": t})
+        assert rebuilt.journal_bytes() == original
+        assert rebuilt.ledger.used_bytes == 600
+
     def test_journal_replays_bit_identically(self, tmp_path):
         journal = str(tmp_path / "admission.journal")
         ctl = rt_adm.AdmissionController(capacity_bytes=1000,
@@ -352,3 +429,38 @@ class TestAdmission:
         parsed = json.loads(line)
         assert list(parsed) == sorted(parsed)
         assert rt_adm.AdmissionDecision.from_line(line) == d
+
+
+# ---------------------------------------------------------------------------
+# queue-server tenant attribution
+# ---------------------------------------------------------------------------
+
+def test_ack_credits_tenant_charged_at_pop_time():
+    """Frames pin the tenant they were CHARGED to at pop time; the ack
+    credits that same account. A rank->tenant rebind between pop and
+    ack (an OP_TENANT processed after GETs already charged 'default')
+    must not drive the new tenant's replay ledger negative while the
+    old one stays inflated."""
+    table = pa.table({"key": list(range(64))})
+    queue = mq.MultiQueue(1)
+    queue.put(0, table)
+    queue.put(0, None)
+    with svc.serve_queue(queue,
+                         tenants={"late": {"weight": 2.0}}) as server:
+        state = server._state(0)
+        frames = server._collect_frames(0, 1, None, False, None)
+        assert frames
+        default = rt_tenancy.DEFAULT_TENANT_ID
+        assert frames[0].tenant == default
+        assert server._tenant_replay[default] == frames[0].size > 0
+        # The binding changes while the frame is in flight.
+        with server._tenant_lock:
+            server._rank_tenant[0] = "late"
+        with state.lock:
+            server._apply_ack(0, state, frames[-1].seq)
+        # Credit landed on the account that was debited: both ledgers
+        # settle at zero — 'late' never goes negative, 'default' never
+        # stays inflated.
+        assert server._tenant_replay[default] == 0
+        assert server._tenant_replay.get("late", 0) == 0
+    queue.shutdown()
