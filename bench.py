@@ -1972,6 +1972,129 @@ def _run_tenancy_leg(filenames, seed: int = 0, hot_weight: float = 3.0,
     return result
 
 
+def _run_elastic_leg(seed: int = 0, num_files: int = 4,
+                     rows_per_file: int = 4_096,
+                     num_reducers: int = 8) -> dict:
+    """Elastic membership leg (membership/): a mid-run rank kill plus a
+    boundary rejoin, measured end to end.
+
+    Phase 1 — failure detection: two live local transports, a
+    ``HeartbeatProber`` on host 0 watching host 1; host 1's process is
+    killed (its transport closed cold, no goodbye) and the leg measures
+    the wall time from the kill to the detector's DOWN verdict —
+    ``member_down_detect_ms``, the real latency a production shrink
+    pays before the plan rewrite can start.
+
+    Phase 2 — resize correctness: a fixed-world
+    :class:`membership.elastic.ElasticShuffleRunner` run is the
+    reference; the elastic run kills rank 1 mid-epoch 0 via the
+    ``member_crash`` chaos site (survivors recompute its undelivered
+    reducers from lineage) and rejoins it — plus a NEW rank, growing
+    the world uneven — at the epoch boundary. ``rows_lost`` MUST be 0
+    and the merged stream bit-identical (reducer outputs are pure in
+    ``(seed, epoch, reducer)``); ``resize_stall_ms`` is the recompute
+    tax from the first death to epoch completion. Hermetic: synthetic
+    parquet in a fresh tempdir, chaos installed and cleared locally.
+    """
+    import tempfile
+    import threading
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from ray_shuffling_data_loader_tpu import membership as mem
+    from ray_shuffling_data_loader_tpu.membership import detector as md
+    from ray_shuffling_data_loader_tpu.membership import elastic as me
+    from ray_shuffling_data_loader_tpu.parallel import transport as tp
+    from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+
+    # Phase 1: detection latency over real sockets. Host 0 probes, so
+    # host 1 observes its heartbeat frames; host 1's detector tracks
+    # rank 0 and the leg measures kill -> DOWN wall time.
+    transports = tp.create_local_transports(2, recv_timeout_s=30.0)
+    down = threading.Event()
+    beats = threading.Semaphore(0)
+    det0 = md.FailureDetector([0], heartbeat_s=0.05, suspect_s=0.4,
+                              on_down=lambda rank: down.set())
+
+    def _observe(src, inc, view, hb):
+        det0.beat(src)
+        beats.release()
+
+    transports[1].set_frame_observer(_observe)
+    prober = md.HeartbeatProber(transports[0], det0, interval_s=0.05)
+    prober.start()
+    # Warm up until a handful of real heartbeats have landed, so the
+    # detector's smoothed inter-arrival window reflects the live link
+    # before the kill (phi is trivially 0.0 at arm time).
+    for _ in range(5):
+        beats.acquire(timeout=2.0)
+    kill_at = timeit.default_timer()
+    prober.stop()          # host 0 goes silent: the kill
+    detect_deadline = timeit.default_timer() + 5.0
+    while not down.is_set() and timeit.default_timer() < detect_deadline:
+        det0.poll()
+        time.sleep(0.01)
+    detect_ms = ((timeit.default_timer() - kill_at) * 1e3
+                 if down.is_set() else None)
+    for t in transports:
+        t.close()
+
+    # Phase 2: shrink + grow correctness and the resize stall.
+    with tempfile.TemporaryDirectory(prefix="rsdl_elastic_") as tmpdir:
+        filenames = []
+        for i in range(num_files):
+            start = i * rows_per_file
+            table = pa.table({"key": pa.array(
+                range(start, start + rows_per_file), type=pa.int64())})
+            path = os.path.join(tmpdir, f"elastic_{i}.parquet")
+            pq.write_table(table, path)
+            filenames.append(path)
+
+        rt_faults.clear()
+        fixed = me.ElasticShuffleRunner(
+            filenames, num_reducers, seed=seed,
+            manager=mem.MembershipManager([0, 1, 2, 3])).run(2)
+
+        rt_faults.install("member_crash:rank1:epoch0", seed=seed)
+        manager = mem.MembershipManager([0, 1, 2, 3])
+        runner = me.ElasticShuffleRunner(filenames, num_reducers,
+                                         seed=seed, manager=manager)
+        start_t = timeit.default_timer()
+        epoch0 = runner.run_epoch(0)
+        stall_ms = float(runner.last_stats.get("resize_stall_ms", 0.0))
+        recomputed = int(runner.last_stats.get("recomputed", 0))
+        shrunk_view = manager.current_view()
+        # Boundary grow: the killed rank rejoins with a bumped
+        # incarnation AND a brand-new rank joins — 5 ranks, uneven.
+        manager.member_join(1, reason="bench rejoin")
+        manager.member_join(4, reason="bench grow")
+        epoch1 = runner.run_epoch(1)
+        elapsed = timeit.default_timer() - start_t
+        rt_faults.clear()
+
+        expected = sum(t.num_rows for epoch in fixed for t in epoch)
+        delivered = me.total_rows(epoch0) + me.total_rows(epoch1)
+        rows_lost = expected - delivered
+        identical = (all(a.equals(b) for a, b in zip(fixed[0], epoch0))
+                     and all(a.equals(b)
+                             for a, b in zip(fixed[1], epoch1)))
+
+    result = {
+        "elastic_rows_per_sec": round(delivered / elapsed, 1),
+        "resize_stall_ms": round(stall_ms, 3),
+        "rows_lost": int(rows_lost),
+        "elastic_shrunk_to": len(shrunk_view.ranks),
+        "elastic_grew_to": len(manager.current_view().ranks),
+        "elastic_recomputed": recomputed,
+        "elastic_ok": bool(rows_lost == 0 and identical
+                           and detect_ms is not None),
+    }
+    if detect_ms is not None:
+        result["member_down_detect_ms"] = round(detect_ms, 1)
+    return result
+
+
 def main() -> None:
     if os.environ.get("RSDL_BENCH_CPU"):
         os.environ.setdefault(
@@ -2082,7 +2205,8 @@ def main() -> None:
 
     phases = [p.strip() for p in os.environ.get(
         "RSDL_BENCH_PHASES",
-        "cached,cold,train,scaling,serve,latency,remote,stream,tenancy"
+        "cached,cold,train,scaling,serve,latency,remote,stream,tenancy,"
+        "elastic"
         ).split(",")
         if p.strip()]
     if os.environ.get("RSDL_BENCH_COLD"):
@@ -2122,7 +2246,7 @@ def main() -> None:
     recovery_before = rsdl_stats.process_recovery_totals()
 
     cached = cold = train = train_agg = scaling = serve = latency = None
-    remote = stream = tenancy = None
+    remote = stream = tenancy = elastic = None
 
     def _phase(name, fn):
         """Run one phase; a failed phase is reported and OMITTED from the
@@ -2291,6 +2415,19 @@ def main() -> None:
                       f"; admitted {tenancy['tenancy_admitted']} rejected "
                       f"{tenancy['tenancy_rejected']}; "
                       f"ok={tenancy['tenancy_ok']}", file=sys.stderr)
+        if "elastic" in phases:
+            elastic = _phase("elastic", lambda: _run_elastic_leg(
+                int(os.environ.get("RSDL_BENCH_SEED", "0"))))
+            if elastic is not None:
+                print(f"# elastic: down detected in "
+                      f"{elastic.get('member_down_detect_ms', 'n/a')}ms; "
+                      f"resize stall {elastic['resize_stall_ms']}ms "
+                      f"({elastic['elastic_recomputed']} reducers "
+                      f"recomputed on survivors); world "
+                      f"4 -> {elastic['elastic_shrunk_to']} -> "
+                      f"{elastic['elastic_grew_to']}; rows lost "
+                      f"{elastic['rows_lost']}; "
+                      f"ok={elastic['elastic_ok']}", file=sys.stderr)
         if "train" in phases:
             train_epochs = int(os.environ.get("RSDL_BENCH_TRAIN_EPOCHS", 4))
             train_batch = int(os.environ.get("RSDL_BENCH_TRAIN_BATCH",
@@ -2428,6 +2565,15 @@ def main() -> None:
                     "wait_mean_ms": 0.0, "timed_epochs": 1,
                     "duration_s": 0.0}
         metric = "tenancy_hot_rows_per_sec"
+    elif elastic is not None:
+        # Elastic-only run (RSDL_BENCH_PHASES=elastic): the headline is
+        # the delivered-row rate of the shrink+grow run — the rate the
+        # elastic plane sustains while paying the resize tax.
+        headline = {"rows_per_s": elastic["elastic_rows_per_sec"],
+                    "stall_pct": 0.0, "stall_s": 0.0,
+                    "wait_mean_ms": 0.0, "timed_epochs": 2,
+                    "duration_s": 0.0}
+        metric = "elastic_rows_per_sec"
     else:
         print(f"no phase produced a result (selected: {phases!r}; a "
               "'# <name> phase FAILED' line above means the phase ran "
@@ -2526,6 +2672,12 @@ def main() -> None:
         # tenant's contended-over-solo p99 are artifacts in the record,
         # not claims in prose.
         record.update(tenancy)
+    if elastic is not None:
+        # Elastic-membership leg (membership/): flat keys so the
+        # bench-diff gate reads member_down_detect_ms / resize_stall_ms
+        # / rows_lost / elastic_ok like any other metric — the rules
+        # skip cleanly against pre-elastic baselines that lack them.
+        record.update(elastic)
     # Runtime-health evidence (runtime/watchdog.py): deadline misses on
     # the supervised bulk transfer/carve path, escalations (a stall
     # persisting past further deadline multiples), and whether the

@@ -145,6 +145,19 @@ class Config:
     static_epoch_exempt_globs: Tuple[str, ...] = (
         "*ray_shuffling_data_loader_tpu/plan/*",
         "*ray_shuffling_data_loader_tpu/streaming/*")
+    # fnmatch patterns of library files where arithmetic over a frozen
+    # world size (range(..world..) / len(self.addresses) fan-outs) is a
+    # fixed-world-assumption violation — world composition belongs to
+    # membership/ (views) and plan/ (rebalance_spans /
+    # reduce_placement), so an elastic resize keeps working.
+    fixed_world_globs: Tuple[str, ...] = (
+        "ray_shuffling_data_loader_tpu/*",)
+    # Exempt: membership/ defines views, plan/ owns the rebalance
+    # arithmetic, and the transport's address table is the dial list
+    # membership layers liveness on top of.
+    fixed_world_exempt_globs: Tuple[str, ...] = (
+        "*ray_shuffling_data_loader_tpu/membership/*",
+        "*ray_shuffling_data_loader_tpu/plan/*")
     # fnmatch patterns of files included in the whole-program
     # concurrency pass (--concurrency). Library code only: tests spin
     # throwaway threads/locks with no cross-module ordering contract.

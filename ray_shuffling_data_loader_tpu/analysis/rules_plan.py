@@ -195,3 +195,88 @@ class StaticEpochAssumptionRule(Rule):
                 "derive the index from the plan (plan.ir.queue_index / "
                 "the EpochSpec being served), not a frozen count")
         return None
+
+
+@register
+class FixedWorldAssumptionRule(Rule):
+    id = "fixed-world-assumption"
+    category = "plan"
+    description = ("library code fanning out over a frozen world size "
+                   "(range(..world..) / len(addresses)) or scaling by "
+                   "it — world composition is a membership view "
+                   "(membership/), and placement over live ranks "
+                   "belongs to plan.ir.rebalance_spans / "
+                   "reduce_placement; frozen-world arithmetic silently "
+                   "breaks elastic resize")
+
+    #: Identifier stems that name a world/host count.
+    _WORLD_STEMS = ("world", "num_hosts", "num_ranks")
+    #: Identifier stems whose len() is a world size in disguise.
+    _ROSTER_STEMS = ("addresses", "hosts", "peers")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        if not ctx.path_matches(ctx.config.fixed_world_globs):
+            return
+        if ctx.path_matches(ctx.config.fixed_world_exempt_globs):
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call):
+                violation = self._check_range(node, ctx)
+                if violation is not None:
+                    yield violation
+            elif isinstance(node, ast.BinOp):
+                violation = self._check_binop(node, ctx)
+                if violation is not None:
+                    yield violation
+
+    def _world_sized(self, node: ast.AST) -> bool:
+        # A world-count name (`self.world`, `num_hosts`) or the length
+        # of a host roster (`len(self.addresses)`, `len(peers)`).
+        for stem in self._WORLD_STEMS:
+            if _mentions(_name_words(node), stem):
+                return True
+        for child in ast.walk(node):
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Name)
+                    and child.func.id == "len" and child.args):
+                words = _name_words(child.args[0])
+                if any(_mentions(words, s) for s in self._ROSTER_STEMS):
+                    return True
+        return False
+
+    def _check_range(self, node: ast.Call, ctx: FileContext):
+        # `range(world)` / `range(len(self.addresses))`: a fan-out that
+        # hard-assumes every configured rank is alive. The live set is
+        # a membership view; placement over it is
+        # plan.ir.rebalance_spans / reduce_placement.
+        func = node.func
+        if not (isinstance(func, ast.Name) and func.id == "range"):
+            return None
+        if any(self._world_sized(arg) for arg in node.args):
+            return ctx.violation(
+                self, node,
+                "fan-out over a frozen world size "
+                "(range(..world../len(addresses)..)); iterate a "
+                "membership view's live ranks and place with "
+                "plan.ir.rebalance_spans / reduce_placement")
+        return None
+
+    def _check_binop(self, node: ast.BinOp, ctx: FileContext):
+        # `x * world` / `q % world` / `n // world`: per-rank shares
+        # computed from the configured size — wrong the moment the
+        # world shrinks or grows. (Add/Sub are untouched: offsets over
+        # a roster are topology math, not a share split.)
+        if not isinstance(node.op, (ast.Mult, ast.Mod, ast.FloorDiv)):
+            return None
+        sides = [node.left, node.right] if isinstance(node.op, ast.Mult) \
+            else [node.right]
+        for side in sides:
+            for stem in self._WORLD_STEMS:
+                if _mentions(_name_words(side), stem):
+                    return ctx.violation(
+                        self, node,
+                        "per-rank share scaled by a frozen world size; "
+                        "derive shares from the live membership view "
+                        "(plan.ir.rebalance_spans over view.ranks)")
+        return None

@@ -111,6 +111,31 @@ def shard_journal_path(path: str, shard_index: int, num_shards: int) -> str:
     return f"{path}.shard{shard_index}"
 
 
+def crc_line(entry: dict) -> str:
+    """Encode one journal record in THE crc'd-line discipline every
+    append-only journal in this repo shares (watermarks, stream ingest,
+    membership views): the ``crc`` field covers the canonical encoding
+    (sorted keys, compact separators) of ``entry``, so a torn tail — the
+    process died mid-write — is detected on load, never misread."""
+    from ray_shuffling_data_loader_tpu import native
+    body = json.dumps(entry, sort_keys=True, separators=(",", ":"))
+    crc = native.crc32(body.encode()) & 0xFFFFFFFF
+    return json.dumps({"crc": crc, "entry": entry}, sort_keys=True,
+                      separators=(",", ":"))
+
+
+def parse_crc_line(line: str) -> dict:
+    """Decode one :func:`crc_line` record, raising ``ValueError`` on a
+    missing or mismatched CRC (the torn-tail shape loaders skip)."""
+    from ray_shuffling_data_loader_tpu import native
+    record = json.loads(line)
+    entry = record["entry"]
+    body = json.dumps(entry, sort_keys=True, separators=(",", ":"))
+    if native.crc32(body.encode()) & 0xFFFFFFFF != record["crc"]:
+        raise ValueError("crc mismatch")
+    return entry
+
+
 class WatermarkJournal:
     """Crc'd append-only journal of per-queue delivered watermarks.
 
@@ -132,11 +157,7 @@ class WatermarkJournal:
 
     @staticmethod
     def _encode(entry: dict) -> str:
-        from ray_shuffling_data_loader_tpu import native
-        body = json.dumps(entry, sort_keys=True, separators=(",", ":"))
-        crc = native.crc32(body.encode()) & 0xFFFFFFFF
-        return json.dumps({"crc": crc, "entry": entry}, sort_keys=True,
-                          separators=(",", ":"))
+        return crc_line(entry)
 
     def record(self, queue_index: int, seq: int, rows: int,
                done: bool = False) -> None:
@@ -176,7 +197,6 @@ class WatermarkJournal:
     def load(cls, path: str) -> Dict[int, WatermarkEntry]:
         """Latest watermark per queue index; lines with a bad/missing
         CRC (torn tail) are skipped with a warning."""
-        from ray_shuffling_data_loader_tpu import native
         state: Dict[int, WatermarkEntry] = {}
         births: Dict[int, Dict[int, tuple]] = \
             collections.defaultdict(dict)
@@ -188,13 +208,7 @@ class WatermarkJournal:
                 if not line:
                     continue
                 try:
-                    record = json.loads(line)
-                    entry = record["entry"]
-                    body = json.dumps(entry, sort_keys=True,
-                                      separators=(",", ":"))
-                    if native.crc32(body.encode()) & 0xFFFFFFFF != \
-                            record["crc"]:
-                        raise ValueError("crc mismatch")
+                    entry = parse_crc_line(line)
                     queue_index = int(entry["q"])
                     if "bseq" in entry:
                         # Frame-birth record: retained only while its
@@ -333,7 +347,6 @@ class StreamJournal:
     def load(cls, path: str) -> "list[dict]":
         """Every intact record, in append order; lines with a bad or
         missing CRC (torn tail) are skipped with a warning."""
-        from ray_shuffling_data_loader_tpu import native
         entries: "list[dict]" = []
         if not os.path.exists(path):
             return entries
@@ -343,13 +356,7 @@ class StreamJournal:
                 if not line:
                     continue
                 try:
-                    record = json.loads(line)
-                    entry = record["entry"]
-                    body = json.dumps(entry, sort_keys=True,
-                                      separators=(",", ":"))
-                    if native.crc32(body.encode()) & 0xFFFFFFFF != \
-                            record["crc"]:
-                        raise ValueError("crc mismatch")
+                    entry = parse_crc_line(line)
                 except (ValueError, KeyError, TypeError) as e:
                     logger.warning(
                         "stream journal %s line %d unreadable (%s); "

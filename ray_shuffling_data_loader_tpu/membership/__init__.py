@@ -1,0 +1,537 @@
+"""Elastic world membership: journaled views, failure-detected shrink,
+boundary grow, and generation fencing.
+
+The reference (and this repo until now) froze the world at construction
+time: ``num_reducers x num_trainers`` chosen at ``shuffle()`` time, and
+``parallel/transport.py`` dialing a flat all-to-all over a fixed
+``world = len(addresses)``. PR 5's leases let a *consumer* die without
+wedging the server, but nothing let a rank leave, rejoin, or join — a
+dead reducer host stalled the epoch until retry budgets exhausted.
+
+This package makes world composition a first-class, journaled,
+crash-recoverable input to the plan:
+
+- :class:`MembershipView` — one immutable world composition:
+  ``(view_id, ranks, incarnations)``. The *rank set* is the reducer
+  hosts; the per-rank **incarnation** counts process generations (a
+  rank that dies and rejoins comes back at incarnation+1, which is what
+  lets the transport fence its zombie predecessor's frames).
+- :func:`apply_event` — the ONE pure transition function. Every view is
+  a fold of events over the bootstrap view, with no wall clock and no
+  dict-order dependence, so a journal replays bit-identically.
+- :class:`MembershipJournal` — the crc'd append-only JSONL discipline of
+  ``checkpoint.WatermarkJournal`` (torn tails skipped, atomic compact)
+  applied to view changes; :func:`replay` re-derives every journaled
+  view through :func:`apply_event` and raises on any byte divergence —
+  recovery and audit in one mechanism (the admission-journal recipe).
+- :class:`MembershipManager` — the runtime hub: owns the current view,
+  journals transitions, fans them out to listeners (elastic runners,
+  the queue server's lease sweep, transports), and emits the
+  ``member_*`` telemetry/metric vocabulary.
+
+Resize semantics (consumed by ``membership/elastic.py`` and
+``streaming/runner.py``): on ``member_down`` the CURRENT epoch completes
+degraded — the dead rank's reducers are re-placed onto survivors
+(``plan.ir.reduce_placement`` over the shrunken rank set) and their
+outputs regenerated from ``(seed, epoch, reducer)`` lineage, exactly
+once against the delivery ledger; on ``member_join`` the world grows at
+the next epoch (batch) or window seal (streaming) — seal window N on the
+old view, open N+1 on the new one, zero replay. Placement never changes
+*content*: a reducer output is a pure function of its lineage key, so a
+resized run's merged stream is bit-identical to the fixed-world run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+#: Journaled event kinds. ``bootstrap``/``snapshot`` carry a whole view
+#: (journal base lines); ``down``/``join`` are the deltas folded over it.
+EVENT_KINDS = ("bootstrap", "snapshot", "down", "join")
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipEvent:
+    """One world transition. ``rank``/``incarnation`` are meaningful for
+    ``down``/``join``; base records (``bootstrap``/``snapshot``) use
+    rank -1. ``reason`` is free text for humans and telemetry (it is
+    inside the crc'd line, so it replays byte-identically too)."""
+
+    kind: str
+    rank: int = -1
+    incarnation: int = 0
+    reason: str = ""
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "rank": self.rank,
+                "incarnation": self.incarnation, "reason": self.reason}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "MembershipEvent":
+        return cls(kind=data["kind"], rank=int(data["rank"]),
+                   incarnation=int(data["incarnation"]),
+                   reason=data.get("reason", ""))
+
+
+@dataclasses.dataclass(frozen=True)
+class MembershipView:
+    """One immutable world composition.
+
+    ``ranks`` is the sorted live rank set; ``incarnations`` maps EVERY
+    rank ever seen (live or not) to its latest process generation —
+    kept for departed ranks so a rejoin resumes at the next generation
+    and the transport can fence the dead generation's frames.
+    """
+
+    view_id: int
+    ranks: Tuple[int, ...]
+    incarnations: Tuple[Tuple[int, int], ...]  # sorted (rank, incarnation)
+
+    def live(self, rank: int) -> bool:
+        return rank in self.ranks
+
+    def incarnation(self, rank: int) -> int:
+        for r, inc in self.incarnations:
+            if r == rank:
+                return inc
+        return 0
+
+    def to_dict(self) -> dict:
+        return {"view_id": self.view_id, "ranks": list(self.ranks),
+                "incarnations": [[r, i] for r, i in self.incarnations]}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "MembershipView":
+        return cls(view_id=int(data["view_id"]),
+                   ranks=tuple(int(r) for r in data["ranks"]),
+                   incarnations=tuple((int(r), int(i))
+                                      for r, i in data["incarnations"]))
+
+    @classmethod
+    def bootstrap(cls, ranks: Sequence[int],
+                  incarnations: Optional[Dict[int, int]] = None
+                  ) -> "MembershipView":
+        ranks = tuple(sorted(set(int(r) for r in ranks)))
+        incarnations = incarnations or {}
+        pairs = tuple(sorted((r, int(incarnations.get(r, 0)))
+                             for r in ranks))
+        return cls(view_id=0, ranks=ranks, incarnations=pairs)
+
+
+def apply_event(view: MembershipView,
+                event: MembershipEvent) -> MembershipView:
+    """THE pure view-transition function: ``(view, event) -> view``.
+
+    No wall clock, no randomness, no dict-order dependence — a journal
+    is a fold of its events over the bootstrap view, and :func:`replay`
+    re-runs the fold to prove the journal. Events that would not change
+    the world (downing an absent rank, a join that is not a newer
+    generation of the rank) return ``view`` UNCHANGED — the manager
+    never journals those, so replay never sees them either.
+    """
+    if event.kind not in EVENT_KINDS:
+        raise ValueError(f"unknown membership event kind {event.kind!r}")
+    if event.kind in ("bootstrap", "snapshot"):
+        raise ValueError(
+            f"{event.kind} records carry their own view; apply_event "
+            "folds only down/join deltas")
+    incarnations = dict(view.incarnations)
+    if event.kind == "down":
+        if event.rank not in view.ranks:
+            return view
+        ranks = tuple(r for r in view.ranks if r != event.rank)
+        pairs = tuple(sorted(incarnations.items()))
+        return MembershipView(view.view_id + 1, ranks, pairs)
+    # join: only a strictly newer generation of a live rank (a restart
+    # the detector never saw die), or any generation of an absent rank
+    # at >= its last known incarnation, changes the world.
+    known = incarnations.get(event.rank, -1) if event.rank in view.ranks \
+        else incarnations.get(event.rank, 0) - 1
+    if event.incarnation <= known:
+        return view
+    incarnations[event.rank] = event.incarnation
+    ranks = tuple(sorted(set(view.ranks) | {event.rank}))
+    pairs = tuple(sorted(incarnations.items()))
+    return MembershipView(view.view_id + 1, ranks, pairs)
+
+
+def next_incarnation(view: MembershipView, rank: int) -> int:
+    """The generation a (re)joining ``rank`` must announce: one past its
+    latest known incarnation (0 for a never-seen rank)."""
+    for r, inc in view.incarnations:
+        if r == rank:
+            return inc + 1
+    return 0
+
+
+class MembershipJournal:
+    """Crc'd append-only journal of membership view changes.
+
+    Each line is ``{"event": ..., "view": ...}`` in the shared
+    :func:`checkpoint.crc_line` discipline: the recorded view is the
+    RESULT of folding the event over the previous line's view, which is
+    what makes the file self-verifying — :func:`replay` re-runs the fold
+    and any divergence (tamper, version skew, an unjournaled transition)
+    raises. The first line is always a base record (``bootstrap``, or
+    ``snapshot`` after :meth:`compact`) carrying the whole view, so
+    replay needs no out-of-band initial state.
+
+    ``path=None`` keeps the journal in memory (unit tests, ephemeral
+    worlds); with a path every line is flushed + fsync'd before the
+    transition is visible, so a crashed coordinator restarts into the
+    exact world it last advertised.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self._lock = threading.Lock()
+        self._file = None
+        self._lines: List[str] = []
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    @staticmethod
+    def encode(event: MembershipEvent, view: MembershipView) -> str:
+        return ckpt.crc_line({"event": event.to_dict(),
+                              "view": view.to_dict()})
+
+    def record(self, event: MembershipEvent, view: MembershipView) -> None:
+        line = self.encode(event, view)
+        with self._lock:
+            self._lines.append(line)
+            if self._path is not None:
+                if self._file is None:
+                    directory = os.path.dirname(os.path.abspath(self._path))
+                    os.makedirs(directory, exist_ok=True)
+                    self._file = open(self._path, "a", encoding="utf-8")
+                self._file.write(line + "\n")
+                self._file.flush()
+                os.fsync(self._file.fileno())
+
+    def journal_bytes(self) -> bytes:
+        """The journal as emitted (the replay-comparison target)."""
+        with self._lock:
+            return "".join(line + "\n" for line in self._lines).encode()
+
+    @classmethod
+    def load(cls, path: str) -> List[dict]:
+        """Every intact ``{"event", "view"}`` record in append order; a
+        torn TAIL line (crash mid-write) is skipped with a warning, but
+        an unreadable line with intact lines after it is corruption and
+        raises — an interior gap would silently rewrite history."""
+        records: List[dict] = []
+        bad: Optional[Tuple[int, str]] = None
+        if not os.path.exists(path):
+            return records
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = ckpt.parse_crc_line(line)
+                    record = {"event": MembershipEvent.from_dict(
+                                  entry["event"]),
+                              "view": MembershipView.from_dict(
+                                  entry["view"]),
+                              "line": line}
+                except (ValueError, KeyError, TypeError) as e:
+                    if bad is not None:
+                        raise ValueError(
+                            f"membership journal {path}: multiple "
+                            f"unreadable lines ({bad[0]}: {bad[1]}; "
+                            f"{lineno}: {e}) — corruption, not a torn "
+                            "tail")
+                    bad = (lineno, str(e))
+                    continue
+                if bad is not None:
+                    raise ValueError(
+                        f"membership journal {path}: line {bad[0]} "
+                        f"unreadable ({bad[1]}) but line {lineno} is "
+                        "intact — interior corruption, not a torn tail")
+                records.append(record)
+        if bad is not None:
+            logger.warning(
+                "membership journal %s line %d unreadable (%s); skipping "
+                "(torn tail from a crash is expected)", path, bad[0],
+                bad[1])
+        return records
+
+    def compact(self) -> None:
+        """Rewrite the journal as ONE snapshot record of the latest
+        view — atomic tmp + fsync + rename (the WatermarkJournal
+        discipline), run at coordinator restart so the append-only file
+        cannot grow unboundedly across churn."""
+        assert self._path is not None, "in-memory journals need no compact"
+        records = self.load(self._path)
+        if not records:
+            return
+        view = records[-1]["view"]
+        line = self.encode(MembershipEvent(kind="snapshot",
+                                           reason="compact"), view)
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            directory = os.path.dirname(os.path.abspath(self._path))
+            os.makedirs(directory, exist_ok=True)
+            fd, tmp_path = tempfile.mkstemp(dir=directory, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.write(line + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp_path, self._path)
+                dir_fd = os.open(directory, os.O_RDONLY)
+                try:
+                    os.fsync(dir_fd)
+                finally:
+                    os.close(dir_fd)
+            except BaseException:
+                if os.path.exists(tmp_path):
+                    os.remove(tmp_path)
+                raise
+            self._lines = [line]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def replay(path: str) -> MembershipView:
+    """Rebuild the latest view from a journal and PROVE the rebuild:
+    every ``down``/``join`` record's view must equal
+    ``apply_event(previous_view, event)`` — re-encoded byte-identically
+    against the journaled line — and the journal must begin with a base
+    record. Any divergence raises ``ValueError`` (tamper, corruption,
+    or version skew in the transition function). Returns the verified
+    latest view."""
+    records = MembershipJournal.load(path)
+    if not records:
+        raise ValueError(f"membership journal {path} has no records")
+    first = records[0]
+    if first["event"].kind not in ("bootstrap", "snapshot"):
+        raise ValueError(
+            f"membership journal {path} does not begin with a "
+            f"bootstrap/snapshot record (got {first['event'].kind!r})")
+    view = first["view"]
+    for index, record in enumerate(records[1:], 2):
+        event = record["event"]
+        if event.kind in ("bootstrap", "snapshot"):
+            raise ValueError(
+                f"membership journal {path} record {index}: base record "
+                "after the journal head (history rewrite)")
+        derived = apply_event(view, event)
+        rederived = MembershipJournal.encode(event, derived)
+        if rederived != record["line"]:
+            raise ValueError(
+                f"membership journal {path} record {index} diverged on "
+                f"replay: event {event.to_dict()} over view "
+                f"{view.view_id} re-derives view {derived.to_dict()}, "
+                "journal disagrees (tamper, corruption, or transition "
+                "version skew)")
+        if derived == view:
+            raise ValueError(
+                f"membership journal {path} record {index}: journaled "
+                f"no-op event {event.to_dict()} (the manager never "
+                "journals unchanged views)")
+        view = derived
+    return view
+
+
+class MembershipManager:
+    """The runtime membership hub: current view + journal + fan-out.
+
+    Transitions come from the failure detector (``member_down``), from
+    join announcements (``member_join``), or from chaos
+    (``member_crash`` via the runners). Each one folds through
+    :func:`apply_event`, is journaled, emits telemetry + metrics, and is
+    delivered to every listener ``cb(event, view)`` — the elastic
+    runner's resize trigger, the queue server's view-aware lease sweep,
+    and the transport's fence all hang off this one callback list.
+    """
+
+    def __init__(self, ranks: Sequence[int],
+                 journal_path: Optional[str] = None,
+                 incarnations: Optional[Dict[int, int]] = None):
+        self._lock = threading.Lock()
+        self._view = MembershipView.bootstrap(ranks, incarnations)
+        self._journal = MembershipJournal(journal_path)
+        self._listeners: List[Callable[[MembershipEvent, MembershipView],
+                                       None]] = []
+        self._journal.record(MembershipEvent(kind="bootstrap",
+                                             reason="initial world"),
+                             self._view)
+        self._suspects: set = set()
+        self._export(self._view)
+
+    # -- state ---------------------------------------------------------
+
+    def current_view(self) -> MembershipView:
+        with self._lock:
+            return self._view
+
+    @property
+    def journal(self) -> MembershipJournal:
+        return self._journal
+
+    def add_listener(self, callback: Callable[[MembershipEvent,
+                                               MembershipView],
+                                              None]) -> None:
+        with self._lock:
+            self._listeners.append(callback)
+
+    # -- transitions ---------------------------------------------------
+
+    def member_down(self, rank: int, reason: str = "") -> MembershipView:
+        """A rank left the world (failure detector verdict, lease
+        expiry, or an operator's drain). Idempotent: downing an absent
+        rank is a no-op (the flapping-detector case)."""
+        return self._transition(MembershipEvent(
+            kind="down", rank=int(rank),
+            incarnation=self.current_view().incarnation(rank),
+            reason=reason))
+
+    def member_join(self, rank: int, incarnation: Optional[int] = None,
+                    reason: str = "") -> MembershipView:
+        """A rank (re)joined. ``incarnation=None`` assigns the next
+        generation for the rank — the number the joining process must
+        announce on its transport so pre-death frames stay fenced."""
+        with self._lock:
+            view = self._view
+        if incarnation is None:
+            incarnation = next_incarnation(view, int(rank))
+        return self._transition(MembershipEvent(
+            kind="join", rank=int(rank), incarnation=int(incarnation),
+            reason=reason))
+
+    def member_suspect(self, rank: int, flap: bool = False) -> None:
+        """Detector soft verdict: telemetry + gauge only — suspicion is
+        not a view change (hysteresis lives in the detector)."""
+        with self._lock:
+            self._suspects.add(int(rank))
+            count = len(self._suspects)
+        if flap:
+            rt_metrics.counter(
+                "rsdl_member_flaps_total",
+                "suspect->alive->suspect flaps absorbed by "
+                "hysteresis").inc()
+            rt_telemetry.record("member_flap", task=int(rank))
+        else:
+            rt_metrics.counter(
+                "rsdl_member_suspects_total",
+                "ranks marked suspect by the failure detector").inc()
+            rt_telemetry.record("member_suspect", task=int(rank))
+        rt_metrics.gauge("rsdl_member_suspect",
+                         "ranks currently suspect").set(count)
+
+    def member_alive(self, rank: int) -> None:
+        """Detector cleared a suspicion (the rank's heartbeats resumed)."""
+        with self._lock:
+            self._suspects.discard(int(rank))
+            count = len(self._suspects)
+        rt_metrics.gauge("rsdl_member_suspect",
+                         "ranks currently suspect").set(count)
+
+    def maybe_crash(self, epoch: int, rank: int) -> bool:
+        """The ``member_crash`` chaos site, checked by runners once per
+        ``(epoch, rank)`` key: when the active spec matches, the rank is
+        downed through the normal transition (so detection, journaling
+        and resize all exercise their real paths) and the caller
+        simulates the process death. Returns True when the crash fired."""
+        from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+        try:
+            rt_faults.inject("member_crash", epoch=epoch, task=rank)
+        except rt_faults.InjectedFault as fault:
+            self.member_down(rank, reason=f"member_crash chaos "
+                                          f"({fault.rule})")
+            return True
+        return False
+
+    def _transition(self, event: MembershipEvent) -> MembershipView:
+        with self._lock:
+            view = apply_event(self._view, event)
+            if view == self._view:
+                return view  # no-op: never journaled, never fanned out
+            self._view = view
+            self._journal.record(event, view)
+            if event.kind == "down":
+                self._suspects.discard(event.rank)
+            listeners = list(self._listeners)
+        logger.warning(
+            "membership: %s rank %d (incarnation %d) -> view %d with "
+            "ranks %s%s", event.kind, event.rank, event.incarnation,
+            view.view_id, list(view.ranks),
+            f" ({event.reason})" if event.reason else "")
+        rt_telemetry.record(f"member_{event.kind}", task=event.rank,
+                            view=view.view_id,
+                            incarnation=event.incarnation,
+                            reason=event.reason)
+        rt_metrics.counter(
+            "rsdl_member_transitions_total",
+            "membership view transitions by kind",
+            kind=event.kind).inc()
+        if event.kind == "down":
+            rt_metrics.counter("rsdl_member_downs_total",
+                               "ranks removed from the world").inc()
+        elif event.kind == "join":
+            rt_metrics.counter("rsdl_member_joins_total",
+                               "ranks added to the world").inc()
+        self._export(view)
+        for callback in listeners:
+            callback(event, view)
+        return view
+
+    def _export(self, view: MembershipView) -> None:
+        rt_metrics.gauge("rsdl_member_view_id",
+                         "current membership view id").set(view.view_id)
+        rt_metrics.gauge("rsdl_member_live",
+                         "live ranks in the current view").set(
+            len(view.ranks))
+        for rank, inc in view.incarnations:
+            rt_metrics.gauge("rsdl_member_incarnation",
+                             "latest process generation per rank",
+                             rank=str(rank)).set(inc)
+        rt_metrics.gauge(
+            "rsdl_member_last_transition_unixtime",
+            "wall-clock time of the last view transition").set(
+            time.time())
+
+    def close(self) -> None:
+        self._journal.close()
+
+
+def reducers_for_view(base_reducers: int, base_world: int,
+                      view: MembershipView) -> int:
+    """The reducer count a streaming window opened on ``view`` should
+    run: the bootstrap ratio ``base_reducers / base_world`` scaled to
+    the live rank count (floor 1). Batch mode never calls this — there
+    the reducer count is fixed and only *placement* moves, which is
+    what keeps a resized batch run bit-identical; a streaming window is
+    free to retopologize because exactly-once is per-``row_offset``,
+    not per-reducer."""
+    if base_world <= 0:
+        raise ValueError("base_world must be > 0")
+    per_rank = max(1, round(base_reducers / base_world))
+    return max(1, per_rank * len(view.ranks))
+
+
+__all__ = ["MembershipEvent", "MembershipView", "MembershipJournal",
+           "MembershipManager", "apply_event", "next_incarnation",
+           "replay", "reducers_for_view", "EVENT_KINDS"]

@@ -1,0 +1,259 @@
+"""Phi-style failure detection over transport heartbeats.
+
+Heartbeats arrive two ways: piggybacked on every data frame the
+generation-fenced transport accepts (``TcpTransport.set_frame_observer``
+feeds every accepted frame's ``src`` here), and from a dedicated prober
+(:class:`HeartbeatProber`) that sends explicit heartbeat control frames
+so idle links between epochs stay observable. The detector itself is a
+pure state machine with an injectable clock — every verdict is a
+function of the beat timeline, so tests drive it deterministically with
+a fake clock and zero sleeps.
+
+Suspicion is phi-style: the detector keeps a smoothed inter-arrival
+interval per rank (floored at the configured heartbeat cadence) and
+computes ``phi = silence / smoothed_interval``; crossing ``member_phi``
+marks the rank SUSPECT (telemetry ``member_suspect``), and silence
+reaching the hard ``member_suspect_s`` deadline declares it DOWN
+(``member_down`` — the membership transition that triggers the resize).
+A beat from a SUSPECT rank clears it back to ALIVE.
+
+Hysteresis: one flapping link must fire once, not storm. After a
+suspicion clears, a re-suspicion within one ``suspect_s`` window is
+counted as a *flap* (``rsdl_member_flaps_total``, telemetry
+``member_flap``) and suppressed from the suspect callback/telemetry;
+the internal state still advances so a genuinely dying rank's DOWN
+deadline is never delayed by its own flapping.
+
+Knobs (``runtime/policy.py``): ``RSDL_MEMBER_HEARTBEAT_S``,
+``RSDL_MEMBER_SUSPECT_S``, ``RSDL_MEMBER_PHI``.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Callable, Deque, Dict, Optional, Sequence
+
+from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+ALIVE, SUSPECT, DOWN = "alive", "suspect", "down"
+
+#: Inter-arrival samples kept per rank for the smoothed interval.
+_WINDOW = 16
+
+
+class FailureDetector:
+    """Per-rank beat bookkeeping -> alive/suspect/down verdicts.
+
+    Callbacks fire from whichever thread calls :meth:`poll` (the prober,
+    or a test): ``on_suspect(rank)`` once per suspicion episode (flaps
+    suppressed), ``on_down(rank)`` once per down verdict, and
+    ``on_alive(rank)`` when a suspect rank's beats resume. A DOWN rank
+    stays down until :meth:`revive` (the join path) re-arms it.
+    """
+
+    def __init__(self, peers: Sequence[int],
+                 heartbeat_s: Optional[float] = None,
+                 suspect_s: Optional[float] = None,
+                 phi_threshold: Optional[float] = None,
+                 clock: Callable[[], float] = time.monotonic,
+                 on_suspect: Optional[Callable[[int], None]] = None,
+                 on_down: Optional[Callable[[int], None]] = None,
+                 on_alive: Optional[Callable[[int], None]] = None):
+        self.heartbeat_s = rt_policy.resolve("member", "member_heartbeat_s",
+                                             override=heartbeat_s)
+        self.suspect_s = rt_policy.resolve("member", "member_suspect_s",
+                                           override=suspect_s)
+        self.phi_threshold = rt_policy.resolve("member", "member_phi",
+                                               override=phi_threshold)
+        self._clock = clock
+        self._on_suspect = on_suspect
+        self._on_down = on_down
+        self._on_alive = on_alive
+        self._lock = threading.Lock()
+        self._state: Dict[int, str] = {}
+        self._last: Dict[int, float] = {}
+        self._intervals: Dict[int, Deque[float]] = {}
+        # End of each rank's flap-suppression window: a suspicion that
+        # RE-fires before this instant is a flap, not a fresh episode.
+        self._quiet_until: Dict[int, float] = {}
+        now = self._clock()
+        with self._lock:
+            for rank in peers:
+                self._arm(int(rank), now)
+
+    def _arm(self, rank: int, now: float) -> None:
+        # Every caller (init/beat/revive) already holds self._lock.
+        # rsdl-lint: disable=lock-mutation
+        self._state[rank] = ALIVE
+        # rsdl-lint: disable=lock-mutation
+        self._last[rank] = now
+        self._intervals[rank] = collections.deque(maxlen=_WINDOW)
+        self._quiet_until.pop(rank, None)
+
+    # -- inputs --------------------------------------------------------
+
+    def beat(self, rank: int, now: Optional[float] = None) -> None:
+        """One heartbeat observation (data frame or probe reply)."""
+        rank = int(rank)
+        now = self._clock() if now is None else now
+        cleared = False
+        with self._lock:
+            if self._state.get(rank) == DOWN:
+                return  # a down verdict is final until revive()
+            if rank not in self._state:
+                self._arm(rank, now)
+            else:
+                self._intervals[rank].append(
+                    max(0.0, now - self._last[rank]))
+                self._last[rank] = now
+            if self._state[rank] == SUSPECT:
+                self._state[rank] = ALIVE
+                # The hysteresis arm: a re-suspicion inside one
+                # suspect_s window of this clear is a flap.
+                self._quiet_until[rank] = now + self.suspect_s
+                cleared = True
+        rt_metrics.counter("rsdl_member_heartbeats_total",
+                           "heartbeats observed by the failure "
+                           "detector").inc()
+        if cleared:
+            logger.info("failure detector: rank %d suspect cleared "
+                        "(beats resumed)", rank)
+            if self._on_alive is not None:
+                self._on_alive(rank)
+
+    def revive(self, rank: int, now: Optional[float] = None) -> None:
+        """Re-arm a DOWN rank (the member_join path)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            self._arm(int(rank), now)
+
+    def forget(self, rank: int) -> None:
+        """Stop tracking a rank that left the world on purpose."""
+        with self._lock:
+            for table in (self._state, self._last, self._intervals,
+                          self._quiet_until):
+                table.pop(int(rank), None)
+
+    # -- verdicts ------------------------------------------------------
+
+    def phi(self, rank: int, now: Optional[float] = None) -> float:
+        """Suspicion level: silence measured in smoothed inter-arrival
+        units (0.0 for untracked/just-armed ranks)."""
+        now = self._clock() if now is None else now
+        with self._lock:
+            return self._phi_locked(int(rank), now)
+
+    def _phi_locked(self, rank: int, now: float) -> float:
+        last = self._last.get(rank)
+        if last is None:
+            return 0.0
+        intervals = self._intervals.get(rank)
+        if intervals:
+            smoothed = max(self.heartbeat_s,
+                           sum(intervals) / len(intervals))
+        else:
+            smoothed = self.heartbeat_s
+        return max(0.0, now - last) / smoothed
+
+    def state(self, rank: int) -> str:
+        with self._lock:
+            return self._state.get(int(rank), DOWN)
+
+    def poll(self, now: Optional[float] = None) -> Dict[int, str]:
+        """Evaluate every tracked rank; fire transition callbacks.
+        Returns ``{rank: transition}`` for ranks that changed state this
+        poll (``suspect``/``down``; flap-suppressed suspicions appear as
+        ``flap``)."""
+        now = self._clock() if now is None else now
+        transitions: Dict[int, str] = {}
+        suspect_cbs, down_cbs, flap_cbs = [], [], []
+        with self._lock:
+            for rank, state in list(self._state.items()):
+                if state == DOWN:
+                    continue
+                silence = now - self._last[rank]
+                if silence >= self.suspect_s:
+                    self._state[rank] = DOWN
+                    transitions[rank] = DOWN
+                    down_cbs.append(rank)
+                    continue
+                if state == ALIVE and \
+                        self._phi_locked(rank, now) >= self.phi_threshold:
+                    self._state[rank] = SUSPECT
+                    if now < self._quiet_until.get(rank, 0.0):
+                        transitions[rank] = "flap"
+                        flap_cbs.append(rank)
+                    else:
+                        transitions[rank] = SUSPECT
+                        suspect_cbs.append(rank)
+        for rank in flap_cbs:
+            logger.warning("failure detector: rank %d flapping "
+                           "(re-suspected inside the hysteresis window; "
+                           "suppressed)", rank)
+        for rank in suspect_cbs:
+            logger.warning("failure detector: rank %d SUSPECT "
+                           "(phi >= %.1f)", rank, self.phi_threshold)
+            if self._on_suspect is not None:
+                self._on_suspect(rank)
+        for rank in down_cbs:
+            logger.error("failure detector: rank %d DOWN (silent for "
+                         ">= %.1fs)", rank, self.suspect_s)
+            if self._on_down is not None:
+                self._on_down(rank)
+        return transitions
+
+
+class HeartbeatProber:
+    """The dedicated prober thread: every ``heartbeat_s`` it sends one
+    heartbeat control frame to each live peer on the transport (so idle
+    links stay observed) and polls the detector. The ``member_flap``
+    chaos site fires here — a matched ``(epoch=None, task=peer)`` key
+    swallows that peer's probe for the round, starving the detector
+    exactly the way a flapping link would."""
+
+    def __init__(self, transport, detector: FailureDetector,
+                 interval_s: Optional[float] = None):
+        self._transport = transport
+        self._detector = detector
+        self._interval_s = (detector.heartbeat_s if interval_s is None
+                            else interval_s)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "HeartbeatProber":
+        self._thread = threading.Thread(
+            target=self._run, daemon=True,
+            name=f"rsdl-member-prober-{self._transport.host_id}")
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+        from ray_shuffling_data_loader_tpu.runtime import telemetry as \
+            rt_telemetry
+        while not self._stop.wait(self._interval_s):
+            for peer in list(self._transport.known_peers()):
+                try:
+                    rt_faults.inject("member_flap", task=peer)
+                except rt_faults.InjectedFault:
+                    # Telemetry twin: the dropped probe is observable.
+                    rt_telemetry.record("member_flap", task=peer,
+                                        fault="probe_dropped")
+                    continue
+                self._transport.send_heartbeat(peer)
+            self._detector.poll()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+
+
+__all__ = ["FailureDetector", "HeartbeatProber", "ALIVE", "SUSPECT",
+           "DOWN"]

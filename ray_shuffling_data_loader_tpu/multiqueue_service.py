@@ -1435,6 +1435,44 @@ class QueueServer:
             target=self._drain_dead_ranks, args=(ranks, redistribute),
             daemon=True, name="rsdl-qserve-lease-drain").start()
 
+    def notify_member_down(self, rank: int) -> None:
+        """View-aware lease sweep (membership/): a ``member_down``
+        verdict force-expires every lease holding queues that route to
+        the dead rank — the failure detector's seconds-scale verdict
+        beats the lease timeout, so the dead rank's queues drain (or
+        redistribute, per ``RSDL_QUEUE_ON_DEAD_CONSUMER``) without
+        waiting out the lease clock."""
+        rank = int(rank)
+        victims: List[_Lease] = []
+        with self._lease_lock:
+            for lease in self._leases.values():
+                if lease.expired:
+                    continue
+                if any(plan_ir.queue_rank(q, self._num_trainers) == rank
+                       for q in lease.queues):
+                    lease.expired = True
+                    victims.append(lease)
+            self._consumers_alive.set(
+                sum(1 for le in self._leases.values() if not le.expired))
+        rt_telemetry.record("member_lease_sweep", task=rank,
+                            leases=[le.consumer_id for le in victims])
+        for lease in victims:
+            logger.warning(
+                "consumer %x: lease force-expired (membership declared "
+                "rank %d down)", lease.consumer_id, rank)
+            self._on_lease_expired(lease)
+
+    def attach_membership(self, manager) -> None:
+        """Subscribe this server to a ``MembershipManager``: each
+        ``down`` transition triggers :meth:`notify_member_down` for the
+        dead rank."""
+
+        def _listener(event, view) -> None:
+            if event.kind == "down":
+                self.notify_member_down(event.rank)
+
+        manager.add_listener(_listener)
+
     def _survivor_rank(self) -> Optional[int]:
         with self._lease_lock:
             ranks = sorted(

@@ -74,6 +74,10 @@ class ShardPlan:
             raise ValueError("world and trainers_per_host must be >= 1")
         self.world = world
         self.trainers_per_host = trainers_per_host
+        # The STATIC shard plan is frozen at launch by contract (the
+        # trainer count never changes under elasticity — membership/
+        # resizes reducer placement, not trainer topology).
+        # rsdl-lint: disable=fixed-world-assumption
         self.num_trainers = world * trainers_per_host
         self.num_files = num_files
         self.num_reducers = num_reducers

@@ -10,15 +10,29 @@ and a blocking tag-matched receive. Payloads are raw bytes (the shuffle
 sends Arrow IPC streams); ``socket.sendall``/``recv`` release the GIL so
 large transfers overlap with map/reduce compute threads.
 
-Wire format per message, all little-endian:
+Wire format per message (v2: generation-fenced), all little-endian:
 
     magic   u32 = 0x5244534C ("RSDL")
     src     u32   sending host id
-    epoch   u64
+    incarnation u32  sender's process generation (membership/)
+    view    u32   sender's membership view id at send time
+    epoch   u64   (2^64-1 = heartbeat control frame, no payload)
     reducer u64
     file    u64
     length  u64   payload byte count
     payload length bytes
+
+**Generation fencing** (PR 18, membership/): every frame carries the
+sender's ``(incarnation, view)``. The receiver tracks the highest
+incarnation seen per src and drops — loudly: a warning, the
+``rsdl_member_fenced_frames_total`` counter, and ``member_fenced_frame``
+telemetry — any frame from an OLDER incarnation (a zombie pre-kill
+process still flushing its socket) or from a view below an explicit
+:meth:`TcpTransport.fence_view` floor (pre-resize stragglers after a
+coordinated view cut). A rejoin re-announces itself implicitly: its
+first frame's higher incarnation advances the fence. Heartbeat control
+frames (epoch sentinel, zero payload) feed the failure detector via the
+frame observer and never touch the tag inbox.
 """
 
 from __future__ import annotations
@@ -37,7 +51,12 @@ from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
 logger = setup_custom_logger(__name__)
 
 _MAGIC = 0x5244534C
-_HEADER = struct.Struct("<IIQQQQ")
+_HEADER = struct.Struct("<IIIIQQQQ")
+
+#: Epoch sentinel marking a heartbeat control frame (zero payload,
+#: never inboxed — it exists to carry ``(src, incarnation, view)`` to
+#: the failure detector across otherwise-idle links).
+_HEARTBEAT_EPOCH = (1 << 64) - 1
 
 # Payloads at least this large move through the native C pump (one writev /
 # one read loop per frame, a single GIL transition). Below it, Python's own
@@ -55,6 +74,30 @@ class TransportError(RuntimeError):
 
 class TransportTimeout(TransportError):
     pass
+
+
+class PeerUnreachable(TransportError):
+    """One specific peer could not be dialed.
+
+    ``connect()`` historically collapsed any peer's failure into a
+    whole-world ``TransportError`` carrying only the LAST ``OSError`` —
+    callers could not tell *which* peer was dead, so partial
+    connectivity (the elastic-membership normal case) was
+    indistinguishable from total failure. This carries the structured
+    facts: ``peer`` (rank), ``address``, ``attempts``, and the
+    underlying ``last_error``.
+    """
+
+    def __init__(self, host_id: int, peer: int, address: Tuple[str, int],
+                 attempts: int, last_error: BaseException):
+        super().__init__(
+            f"host {host_id} could not reach peer {peer} at "
+            f"{address[0]}:{address[1]} after {attempts} attempts: "
+            f"{type(last_error).__name__}: {last_error}")
+        self.peer = peer
+        self.address = address
+        self.attempts = attempts
+        self.last_error = last_error
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -117,13 +160,24 @@ class TcpTransport:
 
     def __init__(self, host_id: int, addresses: Sequence[Tuple[str, int]],
                  recv_timeout_s: float = 600.0,
-                 reconnect_grace_s: float = 5.0):
+                 reconnect_grace_s: float = 5.0,
+                 incarnation: int = 0):
         if not 0 <= host_id < len(addresses):
             raise ValueError(
                 f"host_id {host_id} out of range for {len(addresses)} hosts")
         self.host_id = host_id
         self.addresses = list(addresses)
         self.world = len(addresses)
+        #: This process's generation (membership/): a rank that dies and
+        #: rejoins comes back one higher, so receivers fence the dead
+        #: generation's zombie frames.
+        self.incarnation = int(incarnation)
+        #: Membership view id stamped on outgoing frames.
+        self.view_id = 0
+        self._min_view = 0
+        self._peer_incarnations: Dict[int, int] = {}
+        self._frame_observer = None  # cb(src, incarnation, view, is_hb)
+        self._unreachable: set = set()
         self._recv_timeout_s = recv_timeout_s
         self._reconnect_grace_s = reconnect_grace_s
         # Values are bytes-like: pool-backed memoryviews (remote) or the
@@ -162,51 +216,127 @@ class TcpTransport:
         return self._listener.getsockname()[1]
 
     def connect(self, retries: int = 30,
-                initial_backoff_s: float = 0.1) -> None:
+                initial_backoff_s: float = 0.1,
+                on_unreachable: str = "raise") -> List[int]:
         """Dial every remote peer, retrying to absorb startup skew.
 
         The redial schedule is the shared ``RetryPolicy`` for the
         ``transport`` component: exponential backoff with decorrelated
         jitter (capped at 5s) — a whole slice's hosts dialing a
         late-arriving peer de-synchronize instead of re-dialing in
-        lockstep at a fixed interval. The last underlying ``OSError`` is
-        carried in the raised :class:`TransportError` message.
+        lockstep at a fixed interval.
+
+        Per-peer failure is structured, never all-or-nothing:
+        ``on_unreachable="raise"`` (the historical contract, now with
+        the peer identified) raises :class:`PeerUnreachable` carrying
+        the peer id/address/attempts/cause; ``"skip"`` records the peer
+        as unreachable (``member_unreachable`` telemetry) and keeps
+        dialing the rest — the elastic-membership mode, where a dead or
+        not-yet-joined rank is a view fact, not a fatal error. Returns
+        the list of unreachable peer ids (always empty for
+        ``"raise"``). A skipped peer can be dialed later with
+        :meth:`dial` (the join path) or lazily by :meth:`send`.
         """
+        if on_unreachable not in ("raise", "skip"):
+            raise ValueError(
+                f"on_unreachable must be raise|skip, got "
+                f"{on_unreachable!r}")
         policy = rt_retry.RetryPolicy.for_component(
             "transport", retry_max_attempts=retries + 1,
             retry_initial_backoff_s=initial_backoff_s,
             retry_max_backoff_s=5.0,
             retryable=lambda e: isinstance(e, OSError))
+        unreachable: List[int] = []
+        # The address table is the dial list — the transport's one
+        # legitimate frozen-world walk (membership decides liveness on
+        # top of it). rsdl-lint: disable=fixed-world-assumption
         for peer in range(self.world):
             if peer == self.host_id:
                 continue
-            host, port = self.addresses[peer]
-
-            def _dial(host=host, port=port, peer=peer):
-                sock = socket.create_connection((host, port), timeout=30)
-                # Drop the dial timeout: a timed-out sendall after a
-                # partial write would corrupt the framed stream. Blocking
-                # sends + the receiver-side recv timeout handle dead peers.
-                sock.settimeout(None)
-                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                # connect() runs before any send/recv traffic exists
-                # (single-threaded setup phase), so the per-peer send
-                # locks it creates cannot yet have contenders (the
-                # redial path's _peers write holds _peer_locks[dest];
-                # this one predates every reader):
-                # rsdl-lint: disable=lock-mutation,unguarded-shared-mutation
-                self._peers[peer] = sock
-                self._peer_locks[peer] = threading.Lock()
-
             try:
-                policy.call(_dial, describe=f"dial peer {peer}")
+                policy.call(lambda peer=peer: self._dial_peer(peer),
+                            describe=f"dial peer {peer}")
             except OSError as e:
-                raise TransportError(
-                    f"host {self.host_id} could not reach peer {peer} at "
-                    f"{host}:{port} after {retries + 1} attempts: "
-                    f"{type(e).__name__}: {e}")
+                error = PeerUnreachable(self.host_id, peer,
+                                        self.addresses[peer],
+                                        retries + 1, e)
+                if on_unreachable == "raise":
+                    raise error
+                unreachable.append(peer)
+                self._unreachable.add(peer)
+                logger.warning("host %d: peer %d unreachable, skipping "
+                               "(%s)", self.host_id, peer, error)
+                rt_telemetry.record("member_unreachable", task=peer,
+                                    src=self.host_id)
         logger.info("host %d connected to %d peers", self.host_id,
-                    self.world - 1)
+                    self.world - 1 - len(unreachable))
+        return unreachable
+
+    def _dial_peer(self, peer: int) -> socket.socket:
+        sock = socket.create_connection(self.addresses[peer], timeout=30)
+        # Drop the dial timeout: a timed-out sendall after a partial
+        # write would corrupt the framed stream. Blocking sends + the
+        # receiver-side recv timeout handle dead peers.
+        sock.settimeout(None)
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Setup phase (connect()) is single-threaded, and the lazy-dial
+        # paths write _peers[peer] before any sender can hold its lock
+        # (send() creates the lock first via setdefault):
+        # rsdl-lint: disable=lock-mutation,unguarded-shared-mutation
+        self._peers[peer] = sock
+        self._peer_locks.setdefault(peer, threading.Lock())
+        self._unreachable.discard(peer)
+        return sock
+
+    def dial(self, peer: int, retries: int = 5,
+             initial_backoff_s: float = 0.1) -> None:
+        """Dial ONE peer (the member-join path: a grown world dials the
+        new rank without re-dialing everyone). Raises
+        :class:`PeerUnreachable` on failure."""
+        policy = rt_retry.RetryPolicy.for_component(
+            "transport", retry_max_attempts=retries + 1,
+            retry_initial_backoff_s=initial_backoff_s,
+            retry_max_backoff_s=5.0,
+            retryable=lambda e: isinstance(e, OSError))
+        try:
+            policy.call(lambda: self._dial_peer(peer),
+                        describe=f"dial peer {peer}")
+        except OSError as e:
+            raise PeerUnreachable(self.host_id, peer,
+                                  self.addresses[peer], retries + 1, e)
+
+    # -- membership hooks ----------------------------------------------------
+
+    def known_peers(self) -> List[int]:
+        """Peers with a live dialed connection (the prober's probe set)."""
+        return sorted(self._peers.keys())
+
+    def set_frame_observer(self, callback) -> None:
+        """Install ``cb(src, incarnation, view, is_heartbeat)``, called
+        for every ACCEPTED (non-fenced) frame — the failure detector's
+        piggybacked-heartbeat feed."""
+        self._frame_observer = callback
+
+    def announce(self, incarnation: int,
+                 view_id: Optional[int] = None) -> None:
+        """Re-announce this rank's ``(incarnation, view)`` — the rejoin
+        path: a restarted rank stamps its new generation on every
+        outgoing frame, which is what un-fences it at receivers."""
+        self.incarnation = int(incarnation)
+        if view_id is not None:
+            self.view_id = int(view_id)
+
+    def set_view(self, view_id: int) -> None:
+        """Adopt a membership view id for outgoing frames."""
+        self.view_id = int(view_id)
+
+    def fence_view(self, min_view: int) -> None:
+        """Reject incoming frames stamped with a view below
+        ``min_view`` — the post-resize cut: once a new view is adopted
+        everywhere, stragglers from the old world are dropped loudly
+        instead of corrupting the resized stream."""
+        with self._inbox_cv:
+            self._min_view = int(min_view)
 
     def close(self) -> None:
         self._closed.set()
@@ -261,13 +391,49 @@ class TcpTransport:
                 header = (first if len(first) == _HEADER.size else
                           first + _recv_exact(conn,
                                               _HEADER.size - len(first)))
-                magic, src, epoch, reducer, file_index, length = (
-                    _HEADER.unpack(header))
+                (magic, src, incarnation, view, epoch, reducer,
+                 file_index, length) = _HEADER.unpack(header)
                 if magic != _MAGIC:
                     raise TransportError(
                         f"bad magic {magic:#x} from peer (protocol mismatch)")
                 srcs_seen.add(src)
                 payload = _recv_payload(conn, length)
+                # Generation fence: frames from an older incarnation of
+                # src (a zombie pre-kill process) or from a view below
+                # the fence_view floor are dropped LOUDLY — they are
+                # evidence of a process the world already moved past,
+                # and letting them into the inbox would corrupt the
+                # resized stream with stale data.
+                with self._inbox_cv:
+                    known = self._peer_incarnations.get(src, 0)
+                    stale = incarnation < known or view < self._min_view
+                    if not stale and incarnation > known:
+                        self._peer_incarnations[src] = incarnation
+                if stale:
+                    from ray_shuffling_data_loader_tpu.runtime import (
+                        metrics as rt_metrics)
+                    rt_metrics.counter(
+                        "rsdl_member_fenced_frames_total",
+                        "frames rejected by the incarnation/view "
+                        "fence").inc()
+                    rt_telemetry.record(
+                        "member_fenced_frame", epoch=epoch, task=reducer,
+                        src=src, incarnation=incarnation, view=view)
+                    logger.warning(
+                        "host %d: FENCED stale frame from host %d "
+                        "(incarnation %d < %d or view %d < %d); dropped",
+                        self.host_id, src, incarnation,
+                        self._peer_incarnations.get(src, 0), view,
+                        self._min_view)
+                    payload = None
+                    continue
+                if self._frame_observer is not None:
+                    self._frame_observer(src, incarnation, view,
+                                         epoch == _HEARTBEAT_EPOCH)
+                if epoch == _HEARTBEAT_EPOCH:
+                    # Control frame: detector food only, never inboxed.
+                    payload = None
+                    continue
                 key = (src, (epoch, reducer, file_index))
                 with self._inbox_cv:
                     if key in self._inbox:
@@ -371,8 +537,18 @@ class TcpTransport:
                 f"host {self.host_id} has no connection to peer {dest} "
                 "(connect() not called or peer unreachable)")
         epoch, reducer, file_index = tag
-        header = _HEADER.pack(_MAGIC, self.host_id, epoch, reducer,
-                              file_index, memoryview(payload).nbytes)
+        # Chaos site: a partitioned link drops the frame silently — no
+        # error reaches the sender, exactly like a blackholing switch.
+        # The telemetry twin keeps the drop observable to the harness.
+        try:
+            rt_faults.inject("member_partition", epoch=epoch, task=dest)
+        except rt_faults.InjectedFault:
+            rt_telemetry.record("member_partition", epoch=epoch, task=dest,
+                                src=self.host_id, fault="frame_dropped")
+            return
+        header = _HEADER.pack(_MAGIC, self.host_id, self.incarnation,
+                              self.view_id, epoch, reducer, file_index,
+                              memoryview(payload).nbytes)
         from ray_shuffling_data_loader_tpu import native
 
         def _send_frame(s: socket.socket) -> None:
@@ -427,16 +603,54 @@ class TcpTransport:
                             dur_s=time.monotonic() - send_start, dest=dest,
                             nbytes=memoryview(payload).nbytes)
 
+    def send_heartbeat(self, dest: int) -> None:
+        """Best-effort heartbeat control frame to ``dest`` — zero
+        payload, epoch sentinel, never inboxed at the receiver (it feeds
+        the failure detector through the frame observer). Socket errors
+        are swallowed: a dead link is exactly what the detector's
+        *silence* is for, and the prober must not die with it."""
+        if dest == self.host_id:
+            return
+        try:
+            rt_faults.inject("member_partition", task=dest)
+        except rt_faults.InjectedFault:
+            rt_telemetry.record("member_partition", task=dest,
+                                src=self.host_id,
+                                fault="heartbeat_dropped")
+            return
+        sock = self._peers.get(dest)
+        if sock is None:
+            return
+        header = _HEADER.pack(_MAGIC, self.host_id, self.incarnation,
+                              self.view_id, _HEARTBEAT_EPOCH, 0, 0, 0)
+        lock = self._peer_locks.get(dest)
+        if lock is None:
+            return
+        with lock:
+            try:
+                sock.sendall(header)
+            except OSError:
+                pass
+
 
 def create_local_transports(world: int,
-                            recv_timeout_s: float = 600.0
+                            recv_timeout_s: float = 600.0,
+                            incarnations: Optional[Sequence[int]] = None
                             ) -> List[TcpTransport]:
     """A fully-connected ``world`` of transports on localhost ephemeral
     ports — the single-machine stand-in for a TPU slice's host network,
     used by tests and the multi-host simulation example."""
+    # Harness helper: the frozen walk over `world` here BUILDS the
+    # address table membership layers liveness on top of.
     transports = [
-        TcpTransport(h, [("127.0.0.1", 0)] * world,
-                     recv_timeout_s=recv_timeout_s) for h in range(world)
+        TcpTransport(h,
+                     # rsdl-lint: disable=fixed-world-assumption
+                     [("127.0.0.1", 0)] * world,
+                     recv_timeout_s=recv_timeout_s,
+                     incarnation=(0 if incarnations is None
+                                  else int(incarnations[h])))
+        # rsdl-lint: disable=fixed-world-assumption
+        for h in range(world)
     ]
     for t in transports:
         t.start()

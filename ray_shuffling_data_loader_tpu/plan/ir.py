@@ -43,7 +43,8 @@ import dataclasses
 import itertools
 import json
 import re
-from typing import Any, Dict, Iterable, List, Mapping, Optional, Tuple
+from typing import (Any, Dict, Iterable, List, Mapping, Optional,
+                    Sequence, Tuple)
 
 #: Serialization format version (bumped on breaking shape changes).
 PLAN_VERSION = 1
@@ -122,6 +123,37 @@ def route_slices(num_reducers: int, num_trainers: int
         out.append((start, start + size))
         start += size
     return out
+
+
+def rebalance_spans(num_items: int, live_ranks: Sequence[int]
+                    ) -> Dict[int, Tuple[int, int]]:
+    """Contiguous ``(start, stop)`` item spans re-placed over an
+    ELASTIC rank set: :func:`route_slices` arithmetic, but keyed by the
+    live ranks themselves (sorted) instead of ``range(world)`` — THE
+    membership-resize placement query. A shrunken world hands the dead
+    rank's span to survivors (remainder-first, so the split is uneven
+    but deterministic); a grown world spreads the same items thinner.
+    Placement moves, content never does: the items are still the same
+    global indices, so every task's ``(seed, epoch, task)`` lineage key
+    — and therefore its output — is unchanged by any resize."""
+    ranks = sorted(int(r) for r in live_ranks)
+    if not ranks:
+        raise PlanError("rebalance_spans needs at least one live rank")
+    spans = route_slices(num_items, len(ranks))
+    return {rank: spans[i] for i, rank in enumerate(ranks)}
+
+
+def reduce_placement(num_reducers: int, live_ranks: Sequence[int]
+                     ) -> Dict[int, int]:
+    """``reducer_index -> owning live rank`` under the
+    :func:`rebalance_spans` placement — the inverse view the elastic
+    runner's per-reducer loop wants."""
+    placement: Dict[int, int] = {}
+    for rank, (start, stop) in rebalance_spans(num_reducers,
+                                               live_ranks).items():
+        for reducer in range(start, stop):
+            placement[reducer] = rank
+    return placement
 
 
 def node_id(stage: str, epoch: int, task: int) -> str:
@@ -487,12 +519,20 @@ class EpochSpec:
     stream's window assembler yields them unboundedly as windows close.
     The ``static-epoch-assumption`` rsdl-lint rule pins the inversion:
     library code no longer counts epochs with ``range(num_epochs)``;
-    epochs arrive from here."""
+    epochs arrive from here.
+
+    ``num_reducers`` overrides the driver-wide reducer count for THIS
+    epoch (None = the driver default): the elastic-membership hook that
+    lets a streaming run retopologize at a window seal — window N built
+    on the old view's count, window N+1 on the new one — with zero
+    replay, because each epoch's plan always carried its own reducer
+    count."""
 
     epoch: int
     filenames: Tuple[str, ...]
     window: Optional[Dict[str, Any]] = None
     tenant_id: Optional[str] = None
+    num_reducers: Optional[int] = None
 
 
 def static_epoch_specs(filenames: Iterable[str], num_epochs: int,

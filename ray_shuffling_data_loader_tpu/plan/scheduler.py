@@ -487,3 +487,51 @@ class PlanScheduler:
                                 task=node.key.task, stage=node.stage,
                                 elapsed_s=elapsed, threshold_s=threshold)
             self._dispatch(state, attempt=1, lane=-1)
+
+
+# ---------------------------------------------------------------------------
+# Membership-aware plan rewrite (membership/)
+# ---------------------------------------------------------------------------
+
+
+def rewrite_for_view(plan: ir.EpochPlan,
+                     live_ranks: Sequence[int]) -> int:
+    """Resize-as-plan-rewrite: re-place the plan's reduce and route
+    nodes over the LIVE membership rank set.
+
+    A ``member_down`` mid-epoch does not change *what* the plan
+    computes — every node keeps its ``(seed, epoch, task)`` lineage key,
+    so outputs stay bit-identical — it changes *where*: the dead rank's
+    reduce nodes are handed to survivors via
+    :func:`plan.ir.reduce_placement` (``route_slices`` arithmetic over
+    the shrunken rank set) and each route node follows the trainer-span
+    rebalance the same way. The placement lands in ``node.meta["host"]``
+    (advisory, like ``cost_s`` — excluded from plan equality), which is
+    how the dryrun scene and ``tools/rsdl_plan.py`` show the resized
+    world. Returns the number of nodes whose host changed.
+    """
+    placement = ir.reduce_placement(plan.num_reducers, live_ranks)
+    trainer_host: Dict[int, int] = {}
+    for host, (start, stop) in ir.rebalance_spans(
+            plan.num_trainers, live_ranks).items():
+        for trainer in range(start, stop):
+            trainer_host[trainer] = host
+    moved = 0
+    for node in plan.reduces():
+        host = placement[node.key.task]
+        if node.meta.get("host") not in (None, host):
+            moved += 1
+        node.meta["host"] = host
+    for node in plan.routes():
+        host = trainer_host[int(node.meta.get("rank", node.key.task))]
+        if node.meta.get("host") not in (None, host):
+            moved += 1
+        node.meta["host"] = host
+    if moved:
+        rt_telemetry.record("plan_rewrite", epoch=plan.epoch,
+                            moved=moved, live=sorted(
+                                int(r) for r in live_ranks))
+        logger.warning("plan epoch %d: rewrote %d node placement(s) "
+                       "onto live ranks %s", plan.epoch, moved,
+                       sorted(int(r) for r in live_ranks))
+    return moved

@@ -37,6 +37,17 @@ site                 where it fires
 ``storage_stall``    same boundary, but with ``:delayN`` — a slow remote
                      first byte (latency, not loss); without a delay it
                      behaves like ``storage_read``
+``member_crash``     ``membership.MembershipManager.maybe_crash`` — the
+                     named rank dies at the epoch/window boundary check
+                     (``member_crash:rank2`` — ``rankN`` is sugar for
+                     ``taskN``) and the world shrinks around it
+``member_partition`` ``TcpTransport.send``/``send_heartbeat`` — frames
+                     to the matched dest rank vanish silently (a
+                     blackholing link, not an error), starving the
+                     failure detector
+``member_flap``      ``membership.detector.HeartbeatProber`` — one probe
+                     round to the matched rank is dropped, driving the
+                     detector's flap hysteresis
 ===================  ======================================================
 
 A chaos spec (``RSDL_CHAOS_SPEC`` env var, or :func:`install`) is a
@@ -94,6 +105,11 @@ SITES = frozenset({
     "ack_lost",
     # Storage plane (storage/): the remote-object fetch boundary.
     "storage_read", "storage_stall",
+    # Membership plane (membership/): elastic-world failure shapes.
+    # member_crash kills a rank (``:rankN`` — sugar for taskN) at an
+    # epoch/window boundary check; member_partition blackholes transport
+    # frames to a dest rank; member_flap starves one probe round.
+    "member_crash", "member_partition", "member_flap",
 })
 
 _SPEC_ENVS = ("RSDL_CHAOS_SPEC", "RSDL_FAULTS_SPEC")
@@ -175,7 +191,8 @@ def _parse_rule(text: str) -> ChaosRule:
     rule = ChaosRule(site=site_token, rate=rate, text=text)
     for token in tokens[1:]:
         for prefix, field in (("epoch", "epoch"), ("file", "task"),
-                              ("task", "task"), ("after", "after"),
+                              ("task", "task"), ("rank", "task"),
+                              ("after", "after"),
                               ("delay", "delay_ms"), ("x", "count")):
             if token.startswith(prefix) and token[len(prefix):].isdigit():
                 setattr(rule, field, int(token[len(prefix):]))

@@ -93,6 +93,20 @@ METRIC_NAMES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "rsdl_admission_decisions_total": ("counter", ("action",)),
     "rsdl_admission_waiting": ("gauge", ()),
     "rsdl_admission_used_bytes": ("gauge", ()),
+    # -- elastic membership (membership/ + parallel/transport.py): view
+    #    lifecycle, failure-detector verdicts, and the generation fence --
+    "rsdl_member_view_id": ("gauge", ()),
+    "rsdl_member_live": ("gauge", ()),
+    "rsdl_member_suspect": ("gauge", ()),
+    "rsdl_member_incarnation": ("gauge", ("rank",)),
+    "rsdl_member_heartbeats_total": ("counter", ()),
+    "rsdl_member_suspects_total": ("counter", ()),
+    "rsdl_member_flaps_total": ("counter", ()),
+    "rsdl_member_downs_total": ("counter", ()),
+    "rsdl_member_joins_total": ("counter", ()),
+    "rsdl_member_transitions_total": ("counter", ("kind",)),
+    "rsdl_member_fenced_frames_total": ("counter", ()),
+    "rsdl_member_last_transition_unixtime": ("gauge", ()),
     # -- spill tier (spill.py) --
     "rsdl_spills_total": ("counter", ()),
     "rsdl_spilled_bytes_total": ("counter", ()),

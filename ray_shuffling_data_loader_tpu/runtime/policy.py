@@ -314,6 +314,19 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     "window_max_bytes": (0, int),
     "window_max_wait_s": (0.0, float),
     "window_late_policy": ("admit", str),
+    # Elastic membership (membership/): the failure detector's probe
+    # cadence (RSDL_MEMBER_HEARTBEAT_S — heartbeats ride every data
+    # frame too, the prober only covers idle links), the silence after
+    # which a quiet rank is declared down (RSDL_MEMBER_SUSPECT_S), and
+    # the phi-style suspicion threshold (elapsed silence measured in
+    # smoothed inter-arrival units; crossing it marks the rank SUSPECT
+    # before the hard suspect_s deadline downs it). Hysteresis: a rank
+    # that flaps (suspect -> alive -> suspect inside one suspect_s
+    # window) re-arms silently — one flapping link emits one
+    # member_suspect, not a storm.
+    "member_heartbeat_s": (0.5, float),
+    "member_suspect_s": (3.0, float),
+    "member_phi": (8.0, float),
     # watermark_lag detector (runtime/health.py): how far the serve
     # watermark (stream time fully drained to trainers) may trail the
     # ingest watermark (stream time sealed into closed windows) before

@@ -1588,8 +1588,16 @@ def shuffle_epochs(epoch_specs,
             if throttle_duration > 1e-4:
                 logger.info("epoch %d throttled for %.3fs", epoch_idx,
                             throttle_duration)
+            # An elastic world retopologizes per epoch spec: a window
+            # sealed on a new membership view carries its own reducer
+            # count (plan.ir.EpochSpec.num_reducers); the driver default
+            # covers every fixed-world spec. Trainer count never moves,
+            # so the queue-route keys stay stable across resizes.
+            spec_reducers = (spec.num_reducers
+                             if getattr(spec, "num_reducers", None)
+                             else num_reducers)
             in_progress[epoch_idx] = shuffle_epoch(
-                epoch_idx, spec.filenames, batch_consumer, num_reducers,
+                epoch_idx, spec.filenames, batch_consumer, spec_reducers,
                 num_trainers, pool, seed, start, stats_collector,
                 map_transform, file_cache, reduce_transform, spill_manager,
                 gather_threads, on_bad_file, fault_policies,

@@ -84,7 +84,8 @@ class StreamingShuffleRunner:
                  max_windows: Optional[int] = None,
                  clock_step_s: Optional[float] = None,
                  on_window_served: Optional[Callable[[int], None]] = None,
-                 tenant=None):
+                 tenant=None,
+                 membership=None):
         from ray_shuffling_data_loader_tpu import checkpoint as ckpt
         # The stream's owning tenant: every window spec this runner
         # emits is stamped with its id (plan IR threading) and the
@@ -102,6 +103,17 @@ class StreamingShuffleRunner:
         self.max_windows = max_windows
         self.clock_step_s = clock_step_s
         self._on_window_served = on_window_served
+        # Elastic membership (membership/): when given a
+        # MembershipManager, the world is re-read at every window seal —
+        # the window boundary IS the resize point. Each spec's
+        # num_reducers is retopologized for the live view
+        # (membership.reducers_for_view), and the view id/ranks are
+        # stamped into the window meta for provenance. The base
+        # (num_reducers, world-size) pair is captured once at
+        # construction so retopology is a pure function of the view.
+        self.membership = membership
+        self._base_world = (len(membership.current_view().ranks)
+                            if membership is not None else 0)
         journal = None
         resumed = {"next_window": 0, "events_sealed": 0,
                    "ingest_watermark": float("-inf")}
@@ -153,6 +165,35 @@ class StreamingShuffleRunner:
         if self._on_window_served is not None:
             self._on_window_served(int(meta["index"]))
 
+    def _apply_view(self, spec):
+        """Window-boundary resize: consult the membership view (after
+        giving ``member_crash`` chaos a chance to kill ranks at this
+        boundary) and retopologize the sealed window's reducer count for
+        the live world. Exactly-once stays per-``row_offset`` — a window
+        shuffled with a different reducer count delivers the same rows,
+        just partitioned differently — so a resize never loses or
+        duplicates a row."""
+        manager = self.membership
+        for rank in list(manager.current_view().ranks):
+            manager.maybe_crash(spec.epoch, rank)
+        view = manager.current_view()
+        from ray_shuffling_data_loader_tpu import membership as mem
+        reducers = mem.reducers_for_view(self.num_reducers,
+                                         self._base_world, view)
+        window = spec.window
+        if window is not None:
+            window = dict(window)
+            window["view_id"] = view.view_id
+            window["view_ranks"] = list(view.ranks)
+        if reducers != self.num_reducers:
+            logger.warning(
+                "window %s (epoch %d): world resized to %d rank(s) "
+                "(view %d) — retopologized to %d reducers",
+                window.get("index"), spec.epoch, len(view.ranks),
+                view.view_id, reducers)
+        return dataclasses.replace(spec, num_reducers=reducers,
+                                   window=window)
+
     def _specs(self):
         skip = self.resume_skip_events
         for spec in self.assembler.specs(self.source,
@@ -161,6 +202,8 @@ class StreamingShuffleRunner:
             if self.tenant is not None and spec.tenant_id is None:
                 spec = dataclasses.replace(
                     spec, tenant_id=self.tenant.tenant_id)
+            if self.membership is not None:
+                spec = self._apply_view(spec)
             if spec.window is not None:
                 self._window_meta[spec.epoch] = dict(spec.window)
             self._observe_lag()

@@ -339,6 +339,8 @@ def specs_to_dicts(specs: List[plan_ir.EpochSpec]) -> List[Dict[str, Any]]:
              "window": s.window}
         if s.tenant_id is not None:
             d["tenant_id"] = s.tenant_id
+        if s.num_reducers is not None:
+            d["num_reducers"] = int(s.num_reducers)
         out.append(d)
     return out
 
@@ -349,5 +351,8 @@ def specs_from_dicts(data) -> List[plan_ir.EpochSpec]:
                 filenames=tuple(str(f) for f in d["filenames"]),
                 window=(dict(d["window"])
                         if d.get("window") is not None else None),
-                tenant_id=d.get("tenant_id"))
+                tenant_id=d.get("tenant_id"),
+                num_reducers=(int(d["num_reducers"])
+                              if d.get("num_reducers") is not None
+                              else None))
             for d in data]

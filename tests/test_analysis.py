@@ -573,6 +573,34 @@ STATIC_EPOCH_OK = """
         return current
 """
 
+FIXED_WORLD_RANGE_BAD = """
+    def fan_out(self):
+        for rank in range(self.world):
+            self.submit(rank)
+        for peer in range(len(self.addresses)):
+            self.dial(peer)
+"""
+
+FIXED_WORLD_SCALE_BAD = """
+    def shares(self, total, world):
+        per_rank = total // world
+        owner = total % world
+        return per_rank, owner
+"""
+
+FIXED_WORLD_OK = """
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+
+    def fan_out(self, view, num_reducers):
+        # live ranks from the membership view; shares via plan/
+        placement = plan_ir.reduce_placement(num_reducers, view.ranks)
+        for rank in view.ranks:
+            self.submit(rank)
+        for step in range(3):  # non-world ranges pass
+            pass
+        return placement
+"""
+
 TENANT_BYPASS_BAD = """
     def register(self, kind, name, nbytes):
         # A shared-plane entry point admitting work with no idea whose
@@ -639,6 +667,10 @@ CASES = [
     ("static-epoch-assumption", STATIC_EPOCH_SUBSCRIPT_BAD,
      STATIC_EPOCH_OK,
      {"path": "ray_shuffling_data_loader_tpu/multiqueue_service.py"}),
+    ("fixed-world-assumption", FIXED_WORLD_RANGE_BAD, FIXED_WORLD_OK,
+     {"path": "ray_shuffling_data_loader_tpu/multiqueue_service.py"}),
+    ("fixed-world-assumption", FIXED_WORLD_SCALE_BAD, FIXED_WORLD_OK,
+     {"path": "ray_shuffling_data_loader_tpu/shuffle.py"}),
     ("tenant-context-bypass", TENANT_BYPASS_BAD, TENANT_BYPASS_PARAM_OK,
      {"path": "ray_shuffling_data_loader_tpu/storage/remote.py"}),
     ("tenant-context-bypass", TENANT_BYPASS_BAD, TENANT_BYPASS_AMBIENT_OK,
@@ -686,6 +718,20 @@ def test_static_epoch_assumption_scoped_to_library_code():
     flagged, _ = lint(STATIC_EPOCH_RANGE_BAD,
                       path="ray_shuffling_data_loader_tpu/jax_dataset.py")
     assert "static-epoch-assumption" in flagged
+
+
+def test_fixed_world_assumption_scoped_to_library_code():
+    """membership/ defines views and plan/ owns the rebalance
+    arithmetic — both exempt; tests and tools fan out freely."""
+    for exempt in ("ray_shuffling_data_loader_tpu/membership/elastic.py",
+                   "ray_shuffling_data_loader_tpu/plan/ir.py",
+                   "tests/test_x.py", "tools/rsdl_top.py"):
+        flagged, _ = lint(FIXED_WORLD_RANGE_BAD, path=exempt)
+        assert "fixed-world-assumption" not in flagged, exempt
+    flagged, _ = lint(
+        FIXED_WORLD_RANGE_BAD,
+        path="ray_shuffling_data_loader_tpu/multiqueue_service.py")
+    assert "fixed-world-assumption" in flagged
 
 
 def test_unregistered_metric_scoped_to_library_code():

@@ -167,7 +167,8 @@ def test_transport_send_redials_after_connection_loss():
 
 def _kill_connection_mid_message(sender, receiver_host=1):
     """Send a truncated frame so the receiver marks the src dead."""
-    header = tr._HEADER.pack(tr._MAGIC, sender.host_id, 9, 9, 9, 100)
+    header = tr._HEADER.pack(tr._MAGIC, sender.host_id, 0, 0, 9, 9, 9,
+                             100)
     sock = sender._peers[receiver_host]
     sock.sendall(header + b"only-a-few-bytes")
     sock.shutdown(socket.SHUT_RDWR)

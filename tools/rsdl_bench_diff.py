@@ -44,6 +44,8 @@ from typing import Any, Dict, List, Optional
 #:   higher_bad: fail when cur > base * (1 + pct/100) AND cur - base
 #:               exceeds the absolute slack in ``slack`` (noise floor)
 #:   ceiling:    fail when cur > threshold (current record alone)
+#:   require_true: fail when the key is present but falsy (current
+#:               record alone; absent key skips — pre-feature records)
 DEFAULT_RULES: List[Dict[str, Any]] = [
     {"key": "value", "mode": "lower_bad", "pct": 10.0},
     {"key": "rows_per_s_per_core", "mode": "lower_bad", "pct": 10.0},
@@ -108,6 +110,20 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
     {"key": "tenancy_hot_rows_per_sec", "mode": "lower_bad", "pct": 20.0},
     {"key": "tenancy_latency_ratio_x", "mode": "higher_bad", "pct": 50.0,
      "slack": 0.5},
+    # Elastic-membership leg (membership/): kill->DOWN detection wall
+    # time and the shrink's recompute stall are latency physics (wide
+    # relative thresholds + absolute slack for shared-host scheduler
+    # jitter), while rows_lost and elastic_ok are hard invariants — a
+    # single lost row or a failed leg is a gate failure at ANY host
+    # speed. Records older than r10 lack these keys; the relative and
+    # require_true rules skip cleanly, and the ceiling judges the
+    # current record alone (absence there is a non-finding).
+    {"key": "member_down_detect_ms", "mode": "higher_bad", "pct": 100.0,
+     "slack": 250.0},
+    {"key": "resize_stall_ms", "mode": "higher_bad", "pct": 200.0,
+     "slack": 250.0},
+    {"key": "rows_lost", "mode": "ceiling", "limit": 0.0},
+    {"key": "elastic_ok", "mode": "require_true"},
 ]
 
 
@@ -160,6 +176,23 @@ def compare_records(base: Dict[str, Any], cur: Dict[str, Any],
     for rule in DEFAULT_RULES:
         key, mode = rule["key"], rule["mode"]
         threshold = overrides.get(key, rule.get("pct", rule.get("limit")))
+        if mode == "require_true":
+            # Boolean verdicts (elastic_ok): judged on the current
+            # record alone; an absent key is a pre-feature record and
+            # skips cleanly (absence is NOT the "disappeared" failure —
+            # these legs are opt-in via RSDL_BENCH_PHASES).
+            if key not in cur:
+                continue
+            ok = bool(cur.get(key))
+            findings.append({
+                "key": key, "mode": mode, "base": None,
+                "cur": 1.0 if ok else 0.0, "delta_pct": None,
+                "threshold_pct": None, "ok": ok,
+                "reason": ("verdict true" if ok
+                           else "verdict false (leg failed its own "
+                                "invariants)"),
+            })
+            continue
         cur_v = _num(cur, key)
         if cur_v is None:
             # A metric the baseline measured but the current record lost

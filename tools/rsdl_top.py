@@ -322,6 +322,45 @@ def render_tenants(parsed: dict) -> list:
     return lines
 
 
+def render_membership(parsed: dict) -> list:
+    """One membership line (membership/): current view id, live vs
+    suspect rank counts, per-rank incarnations, the fenced-frame count,
+    and the age of the last view transition — the "did the world just
+    resize, and is anything flapping" one-liner. Silent when the
+    process never ran elastic membership."""
+    import time as _time
+    view = _scalar(parsed, "rsdl_member_view_id")
+    live = _scalar(parsed, "rsdl_member_live")
+    transitions = sum(
+        parsed.get("rsdl_member_transitions_total", {}).values())
+    if not live and not transitions:
+        return []
+    suspect = _scalar(parsed, "rsdl_member_suspect")
+    fenced = _scalar(parsed, "rsdl_member_fenced_frames_total")
+    flaps = _scalar(parsed, "rsdl_member_flaps_total")
+    incarnations = _by_label(parsed, "rsdl_member_incarnation", "rank")
+    line = (f"membership: view {int(view)}   live {int(live)}"
+            f"  suspect {int(suspect)}")
+    if incarnations:
+        detail = " ".join(
+            f"r{rank}:{int(inc)}"
+            for rank, inc in sorted(incarnations.items(),
+                                    key=lambda kv: int(kv[0])))
+        line += f"   incarnations {detail}"
+    last = _scalar(parsed, "rsdl_member_last_transition_unixtime")
+    if last:
+        # Cross-process age: the gauge IS a serialized wall-clock
+        # timestamp, so wall clock is the only comparable clock here.
+        # rsdl-lint: disable=wallclock-interval
+        age = max(0.0, _time.time() - last)
+        line += f"   last transition {age:.0f}s ago"
+    if fenced:
+        line += f"   FENCED {int(fenced)}"
+    if flaps:
+        line += f"   flaps {int(flaps)}"
+    return [line]
+
+
 def render_latency(parsed: dict, before: dict = None) -> list:
     """Per-queue delivery-latency lines (runtime/latency.py sketch):
     p50/p95/p99 of the end-to-end birth->delivered hop plus the queue's
@@ -473,6 +512,7 @@ def render(parsed: dict, before: dict = None, interval_s: float = None
     lines.extend(render_shards(parsed))
     lines.extend(render_storage(parsed))
     lines.extend(render_tenants(parsed))
+    lines.extend(render_membership(parsed))
     lines.extend(render_streaming(parsed))
     lines.extend(render_latency(parsed, before=before if rate_mode
                                 else None))
