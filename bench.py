@@ -827,6 +827,121 @@ def _load_bench_diff():
     return module
 
 
+#: Record keys that are deliberately informational — context the record
+#: carries for forensics, not measurements a two-record gate could
+#: meaningfully threshold. rsdl-lint's `ungated-bench-metric` rule
+#: accepts a numeric record key only when it is covered by a
+#: tools/rsdl_bench_diff.py DEFAULT_RULES prefix or listed here; a new
+#: numeric emission must pick a side explicitly.
+BENCH_INFORMATIONAL_KEYS = frozenset({
+    # Invocation shape (identity, not measurement).
+    "host_cpus", "num_workers", "num_reducers", "num_trainers",
+    "batch_size", "prefetch_size", "rows", "epochs", "step_ms",
+    "max_inflight_bytes", "telemetry_events", "fault_events",
+    "fault_events_joinable", "chaos_rate",
+    # Diagnostic refinements of quantities gated through another rule:
+    # train_rows_per_sec gates the step-time/throughput family,
+    # stall_pct_under_train gates the stall contract, train_diverged
+    # gates convergence, telemetry_overhead_pct (ceiling) gates the ON
+    # cost — the OFF cost is the proof the kill switch is free.
+    "train_step_ms_mean", "train_compute_rows_per_sec",
+    "train_wait_mean_ms", "train_stall_s", "train_dev_util_pct",
+    "train_final_loss", "telemetry_overhead_off_pct",
+    # Cold ingest is producer-bound BY CONSTRUCTION (near-zero-work
+    # consumer): its stall share carries no contract.
+    "cold_stall_pct",
+    # Ratio against the in-run pandas reference: the reference's own
+    # timing noise dominates; cold_rows_per_sec gates the regime.
+    "vs_baseline_cached",
+})
+
+
+def _bench_provenance() -> dict:
+    """Measurement provenance stamped into every record: WHAT code ran
+    (git rev + dirty flag) on WHAT machine (host + CPU fingerprint).
+    The r09->r10 'regression' was a slower bench host that nothing in
+    the records could falsify — rsdl_regress/rsdl_bench_diff warn on
+    cross-host or dirty-tree comparisons using exactly these fields.
+    Every probe is fail-soft: a record without git is still a record."""
+    import platform
+    import socket
+    import subprocess
+    repo = os.path.dirname(os.path.abspath(__file__))
+    prov: dict = {
+        "git_rev": None,
+        "tree_dirty": None,
+        "host": socket.gethostname(),
+        "host_cpus": os.cpu_count(),
+        "cpu_model": None,
+        "python": platform.python_version(),
+        "platform": platform.platform(),
+    }
+    try:
+        prov["git_rev"] = subprocess.run(
+            ["git", "rev-parse", "HEAD"], cwd=repo, capture_output=True,
+            text=True, timeout=10, check=True).stdout.strip()
+        status = subprocess.run(
+            ["git", "status", "--porcelain"], cwd=repo,
+            capture_output=True, text=True, timeout=10, check=True)
+        prov["tree_dirty"] = bool(status.stdout.strip())
+    except (OSError, subprocess.SubprocessError):
+        pass
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as f:
+            for line in f:
+                if line.lower().startswith("model name"):
+                    prov["cpu_model"] = line.split(":", 1)[1].strip()
+                    break
+    except OSError:
+        pass
+    return prov
+
+
+def _capture_round_capsule(record: dict) -> "str | None":
+    """Per-round flight capsule (same layout as the runtime/health.py
+    incident capsules, consumed by runtime/regress.py): the merged
+    trace dumps, federated metrics, history slice, and resolved
+    policy+env behind THIS record, written beside it and referenced
+    from ``record["capsule"]``. Runs after every phase has finished —
+    outside all timed windows, so telemetry_overhead_pct is untouched.
+    Fail-soft: a capsule failure costs the forensics, never the record."""
+    from ray_shuffling_data_loader_tpu.runtime import health as rt_health
+    from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
+    base_dir = rt_policy.resolve("bench", "bench_capsule_dir") or "."
+    stem = f"bench-{os.getpid()}-r.capsule"
+    try:
+        capsule = rt_health.capture_incident(
+            reason="bench-round", base_dir=base_dir, profile_s=0.0,
+            cooldown_s=0.0, stem=stem)
+    except Exception as e:  # noqa: BLE001 - forensics must not fail the run
+        print(f"# bench capsule capture FAILED: {type(e).__name__}: {e}",
+              file=sys.stderr)
+        return None
+    if capsule is None:
+        return None
+    try:
+        record["capsule"] = os.path.relpath(capsule)
+    except ValueError:
+        record["capsule"] = capsule
+    try:
+        # The record itself rides in the capsule (self-contained when
+        # the directory travels without its BENCH_r*.json), and the
+        # manifest's file list is refreshed to include it.
+        with open(os.path.join(capsule, "record.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(record, f, indent=2, sort_keys=True)
+        manifest_path = os.path.join(capsule, "capsule.json")
+        with open(manifest_path, encoding="utf-8") as f:
+            manifest = json.load(f)
+        manifest["files"] = sorted(os.listdir(capsule))
+        with open(manifest_path, "w", encoding="utf-8") as f:
+            json.dump(manifest, f, indent=2)
+    except (OSError, ValueError) as e:
+        print(f"# bench capsule record embed FAILED: {e}",
+              file=sys.stderr)
+    return capsule
+
+
 def _chaos_rate_from_invocation() -> "float | None":
     """``--chaos`` / ``--chaos=RATE`` argv flag or RSDL_BENCH_CHAOS_RATE."""
     rate = None
@@ -2232,6 +2347,16 @@ def main() -> None:
     rt_tel.install_signal_dump()
     rt_health.install_incident_signal()
     rt_metrics.maybe_start_shard_writer()
+    # Per-round flight capsule (runtime/regress.py): capture collects
+    # sibling trace dumps from the shared RSDL_TRACE_DIR, so it is
+    # pinned BEFORE any worker pool forks (children inherit it via the
+    # environment). RSDL_BENCH_CAPSULE=0 skips both, restoring the
+    # pre-capsule bench byte for byte.
+    bench_capsule = rt_policy.resolve("bench", "bench_capsule")
+    if bench_capsule and not rt_policy.resolve("telemetry", "trace_dir"):
+        import tempfile
+        os.environ["RSDL_TRACE_DIR"] = tempfile.mkdtemp(
+            prefix="rsdl-bench-trace-")
     if (rt_policy.resolve("metrics", "metrics_file")
             or rt_policy.resolve("metrics", "metrics_port")):
         rt_metrics.start_exporter()
@@ -2877,6 +3002,13 @@ def main() -> None:
             # per-run train_* fields above already come from the MEDIAN
             # run; these expose the spread and flag noisy-host episodes.
             record.update(train_agg)
+
+    # Measurement honesty: what code ran on what machine, so two
+    # records can be judged comparable BEFORE their deltas are believed
+    # (rsdl_regress / rsdl_bench_diff cross-check these).
+    record["provenance"] = _bench_provenance()
+    if bench_capsule:
+        _capture_round_capsule(record)
 
     print(json.dumps(record))
 

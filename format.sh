@@ -103,6 +103,15 @@ if ls BENCH_r*.json >/dev/null 2>&1; then
     python tools/rsdl_bench_diff.py --check .
 fi
 
+# Regression-forensics self-test (tools/rsdl_regress.py, stdlib-only):
+# synthesizes a two-round pair with a planted suspect (one stage 3x
+# slower, its latency histogram shifted, one env knob appeared) and
+# requires the differential engine to rank the plant #1 — alignment,
+# bucket-overlap significance, or suspect-scoring drift fails here,
+# not in a forensic report that quietly blames the wrong stage.
+echo "-- rsdl-regress (check mode)"
+python tools/rsdl_regress.py --check >/dev/null
+
 # Run-report schema smoke (tools/rsdl_report.py, stdlib-only): validates
 # that the committed bench records (and any history/capsule artifacts
 # handed to it) still parse against the report's schema without writing

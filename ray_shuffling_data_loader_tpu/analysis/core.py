@@ -184,6 +184,10 @@ class Config:
     tenancy_entry_names: Tuple[str, ...] = (
         "serve_queue", "serve_pipeline", "server_config", "register",
         "make_prefetcher")
+    # fnmatch patterns of files that assemble the bench JSON record —
+    # their numeric emissions must be gated by a rsdl_bench_diff rule
+    # or declared informational (rules_bench.py).
+    bench_record_globs: Tuple[str, ...] = ("bench.py", "*/bench.py")
 
     @classmethod
     def from_dict(cls, data: dict) -> "Config":
@@ -229,8 +233,8 @@ def register(cls):
 def all_rules() -> Dict[str, Rule]:
     """The registry, with the built-in rule modules imported."""
     from ray_shuffling_data_loader_tpu.analysis import (  # noqa: F401
-        rules_arrow, rules_executor, rules_hygiene, rules_jax, rules_lock,
-        rules_metrics, rules_perf, rules_plan, rules_runtime,
+        rules_arrow, rules_bench, rules_executor, rules_hygiene, rules_jax,
+        rules_lock, rules_metrics, rules_perf, rules_plan, rules_runtime,
         rules_storage, rules_telemetry, rules_tenancy)
     return dict(_REGISTRY)
 

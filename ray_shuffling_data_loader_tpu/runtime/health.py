@@ -888,9 +888,12 @@ def capture_incident(reason: str = "on-demand",
                      base_dir: Optional[str] = None,
                      profile_s: Optional[float] = None,
                      wait_s: Optional[float] = None,
-                     cooldown_s: Optional[float] = None) -> Optional[str]:
+                     cooldown_s: Optional[float] = None,
+                     stem: Optional[str] = None) -> Optional[str]:
     """Write one incident capsule directory; returns its path (None when
-    suppressed by the capture cooldown).
+    suppressed by the capture cooldown). ``stem`` overrides the
+    ``rsdl-incident-<pid>-<seq>`` directory name — bench.py names its
+    per-round flight capsules after the record they accompany.
 
     Layout (rendered by ``tools/rsdl_incident.py``)::
 
@@ -919,8 +922,9 @@ def capture_incident(reason: str = "on-demand",
         _capsule_seq += 1
         seq = _capsule_seq
     detector = (verdict or {}).get("detector")
-    stem = f"rsdl-incident-{os.getpid()}-{seq}" + (
-        f"-{detector}" if detector else "")
+    if stem is None:
+        stem = f"rsdl-incident-{os.getpid()}-{seq}" + (
+            f"-{detector}" if detector else "")
     capsule = os.path.join(_capsule_base_dir(base_dir), stem)
     traces_dir = os.path.join(capsule, "traces")
     os.makedirs(traces_dir, exist_ok=True)
@@ -951,7 +955,7 @@ def capture_incident(reason: str = "on-demand",
     if ring is not None:
         with open(os.path.join(capsule, "history.json"), "w",
                   encoding="utf-8") as f:
-            json.dump(ring.slice(), f)
+            json.dump(rt_history.downsample_slice(ring.slice()), f)
 
     # 3. Resolved policy + environment (the "what was configured" half
     #    every incident review starts with).

@@ -258,6 +258,25 @@ class HistoryRing:
         }
 
 
+def downsample_slice(data: Dict[str, Any],
+                     max_snapshots: int = 120) -> Dict[str, Any]:
+    """Bound a :meth:`HistoryRing.slice` payload to ``max_snapshots``
+    snapshots by even-stride decimation that always keeps the newest
+    snapshot (the one incident reviews start from) and the oldest (the
+    pre-incident baseline). Capsule writers call this so a long-lived
+    ring cannot balloon a forensic capsule; the result is still a valid
+    ``rsdl-history-v1`` slice."""
+    snaps = data.get("snapshots", [])
+    if max_snapshots < 2 or len(snaps) <= max_snapshots:
+        return data
+    stride = (len(snaps) - 1) / float(max_snapshots - 1)
+    keep = sorted({round(i * stride) for i in range(max_snapshots)}
+                  | {0, len(snaps) - 1})
+    out = dict(data)
+    out["snapshots"] = [snaps[i] for i in keep if i < len(snaps)]
+    return out
+
+
 def load_slice(data: Dict[str, Any]) -> HistoryRing:
     """Rebuild a ring from :meth:`HistoryRing.slice` output."""
     if data.get("schema") != "rsdl-history-v1":

@@ -621,6 +621,47 @@ def sketch_quantiles(samples: Dict[str, "Dict[Labels, float]"],
     return out
 
 
+def distribution_masses(samples: Dict[str, "Dict[Labels, float]"],
+                        family: str, kind: str
+                        ) -> "Dict[Labels, Dict[float, float]]":
+    """Per-group bucket/centroid mass of one distribution family from
+    PARSED exposition samples: ``{group_labels: {edge: mass}}``.
+
+    For histograms the cumulative ``_bucket`` series is differenced into
+    per-bucket mass (edge = ``le`` upper bound, ``+Inf`` included); for
+    sketches the ``_centroid`` counts are already masses (edge = the
+    centroid value). Group labels drop the structural ``le``/``c``
+    label. This is the one shape the differential engine
+    (``runtime/regress.py``) compares distributions in, so histogram
+    and sketch families diff through identical bucket-overlap math.
+    """
+    struct_label = "le" if kind == "histogram" else "c"
+    series = samples.get(
+        f"{family}_bucket" if kind == "histogram" else f"{family}_centroid",
+        {})
+    grouped: Dict[Labels, Dict[float, float]] = {}
+    for labels, value in series.items():
+        d = dict(labels)
+        edge_txt = d.pop(struct_label, None)
+        if edge_txt is None:
+            continue
+        edge = float("inf") if edge_txt == "+Inf" else float(edge_txt)
+        key = tuple(sorted(d.items()))
+        grouped.setdefault(key, {})[edge] = \
+            grouped.get(key, {}).get(edge, 0.0) + value
+    if kind != "histogram":
+        return grouped
+    out: Dict[Labels, Dict[float, float]] = {}
+    for key, cumulative in grouped.items():
+        masses: Dict[float, float] = {}
+        prev = 0.0
+        for edge in sorted(cumulative):
+            masses[edge] = max(0.0, cumulative[edge] - prev)
+            prev = cumulative[edge]
+        out[key] = masses
+    return out
+
+
 # ---------------------------------------------------------------------------
 # Multi-process federation: per-pid exposition shards + merge reader
 # ---------------------------------------------------------------------------

@@ -148,6 +148,13 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     "incident_dir": ("", str),
     "incident_profile_s": (0.25, float),
     "incident_wait_s": (2.0, float),
+    # Per-round bench flight capsules (bench.py + runtime/regress.py):
+    # after the phases finish (outside every timed window) bench.py
+    # captures an incident-layout capsule beside the record —
+    # RSDL_BENCH_CAPSULE=0 restores pre-capsule bench behavior exactly.
+    # Capture dir "" = the record's directory (cwd).
+    "bench_capsule": (True, _parse_bool),
+    "bench_capsule_dir": ("", str),
     # Cross-process queue service (multiqueue_service.py) socket hygiene:
     # recv timeout applied to BOTH serve_queue connections and
     # RemoteQueue dials (0 = no timeout — a deliberate infinite wait;

@@ -420,6 +420,35 @@ def analyze(events: Sequence[Dict[str, Any]],
     }
 
 
+def stage_table(analysis: Dict[str, Any]) -> Dict[str, Dict[str, float]]:
+    """Per-stage summary of one :func:`analyze` result, normalized per
+    epoch so two runs with different epoch counts compare directly:
+    ``{stage: {cp_ms, cp_ms_per_epoch, pct, self_ms}}``. The epoch
+    normalization is what lets ``runtime/regress.py`` align stages
+    across rounds by ``(kind, epoch-normalized rank)`` instead of raw
+    wall totals."""
+    n_epochs = max(1, len(analysis.get("epochs") or []))
+    self_ms = analysis.get("self_time_ms", {})
+    table: Dict[str, Dict[str, float]] = {}
+    for entry in analysis.get("critical_path", []):
+        stage = entry["stage"]
+        table[stage] = {
+            "cp_ms": entry["cp_ms"],
+            "cp_ms_per_epoch": round(entry["cp_ms"] / n_epochs, 3),
+            "pct": entry["pct"],
+            "self_ms": self_ms.get(stage, 0.0),
+        }
+    # Stages with self time but no critical-path presence still appear
+    # (cp 0): a stage ENTERING the path between two rounds needs its
+    # baseline row to diff against.
+    for stage, ms in self_ms.items():
+        table.setdefault(stage, {
+            "cp_ms": 0.0, "cp_ms_per_epoch": 0.0, "pct": 0.0,
+            "self_ms": ms,
+        })
+    return table
+
+
 def bench_fields(events: Sequence[Dict[str, Any]],
                  whatif_speedup: float = 2.0) -> Dict[str, Any]:
     """The bench-record slice of :func:`analyze`: compact

@@ -624,6 +624,34 @@ TENANT_BYPASS_AMBIENT_OK = """
         return True
 """
 
+UNGATED_BENCH_ASSIGN_BAD = """
+    def main(record, leg):
+        record["surprise_rows_per_hour"] = round(leg.rate * 3600, 1)
+"""
+
+UNGATED_BENCH_UPDATE_BAD = """
+    def main(record, leg):
+        record.update({
+            "surprise_latency_ms": round(leg.wait * 1000, 3),
+        })
+"""
+
+UNGATED_BENCH_OK = """
+    BENCH_INFORMATIONAL_KEYS = frozenset({
+        "debug_probe_count",
+    })
+
+    def main(record, leg):
+        # gated exactly by a DEFAULT_RULES key
+        record["train_rows_per_sec"] = round(leg.rate, 1)
+        # gated as a refinement of the train_rows_per_sec family
+        record["train_rows_per_sec_median"] = round(leg.median, 1)
+        # declared informational in the module's own allowlist
+        record["debug_probe_count"] = round(leg.probes)
+        # non-numeric emissions are out of scope
+        record["backend"] = leg.backend
+"""
+
 CASES = [
     ("lock-mutation", LOCK_MUTATION_BAD, LOCK_MUTATION_OK, {}),
     ("lock-blocking-call", LOCK_BLOCKING_BAD, LOCK_BLOCKING_OK, {}),
@@ -675,6 +703,10 @@ CASES = [
      {"path": "ray_shuffling_data_loader_tpu/storage/remote.py"}),
     ("tenant-context-bypass", TENANT_BYPASS_BAD, TENANT_BYPASS_AMBIENT_OK,
      {"path": "ray_shuffling_data_loader_tpu/multiqueue_service.py"}),
+    ("ungated-bench-metric", UNGATED_BENCH_ASSIGN_BAD, UNGATED_BENCH_OK,
+     {"path": "bench.py"}),
+    ("ungated-bench-metric", UNGATED_BENCH_UPDATE_BAD, UNGATED_BENCH_OK,
+     {"path": "bench.py"}),
 ]
 
 

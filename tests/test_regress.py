@@ -1,0 +1,268 @@
+"""Regression forensics plane (runtime/regress.py + tools/rsdl_regress.py):
+round capsules in, suspect-ranked differential report out."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from ray_shuffling_data_loader_tpu.runtime import regress
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# Synthetic round builders: a record wrapper + a flight capsule on disk,
+# with one dial (reduce seconds / histogram shift / env) per scenario.
+# ---------------------------------------------------------------------------
+
+
+def _trace_dump(reduce_s, n_epochs):
+    """One recorder JSONL dump: per epoch, map_read -> reduce ->
+    train_step back to back."""
+    lines = [json.dumps({"kind": "dump_meta", "pid": 1000,
+                         "time_unix": 1000.0, "t_mono": 0.0,
+                         "events_total": 3 * n_epochs})]
+    t = 1.0
+    for epoch in range(n_epochs):
+        for kind, dur, task in (("map_read", 0.10, 0),
+                                ("reduce", reduce_s, 0),
+                                ("train_step", 0.10, None)):
+            t += dur
+            ev = {"kind": kind, "epoch": epoch, "t_mono": t,
+                  "dur_s": dur}
+            if task is not None:
+                ev["task"] = task
+            lines.append(json.dumps(ev))
+        t += 0.01
+    return "\n".join(lines) + "\n"
+
+
+def _exposition(reduce_shifted, noise=0):
+    """One histogram family, two stage groups: map_read's masses get
+    ``noise`` extra tail samples (same buckets — a non-shift), reduce's
+    mass moves two buckets right when ``reduce_shifted``."""
+    edges = [0.1, 0.2, 0.4, 0.8]
+    counts = {
+        "map_read": [30, 2 + noise, 0, 0],
+        "reduce": [0, 4, 24, 4] if reduce_shifted else [4, 24, 4, 0],
+    }
+    lines = ["# TYPE rsdl_stage_latency_seconds histogram"]
+    for stage, masses in sorted(counts.items()):
+        cumulative, total = 0, 0.0
+        for edge, n in zip(edges, masses):
+            cumulative += n
+            total += n * edge
+            lines.append(
+                f'rsdl_stage_latency_seconds_bucket{{le="{edge}",'
+                f'stage="{stage}"}} {cumulative}')
+        lines.append(
+            f'rsdl_stage_latency_seconds_bucket{{le="+Inf",'
+            f'stage="{stage}"}} {cumulative}')
+        lines.append(
+            f'rsdl_stage_latency_seconds_sum{{stage="{stage}"}} {total}')
+        lines.append(
+            f'rsdl_stage_latency_seconds_count{{stage="{stage}"}} '
+            f'{cumulative}')
+    return "\n".join(lines) + "\n"
+
+
+def make_round(tmp_path, name, *, value=1000.0, reduce_s=0.10,
+               n_epochs=2, reduce_shifted=False, noise=0, env=None,
+               policy=None, capsule=True, provenance=None, extra=None):
+    record = {"metric": "rows_per_sec", "value": value, "unit": "rows/s"}
+    if provenance is not None:
+        record["provenance"] = provenance
+    if extra:
+        record.update(extra)
+    record_path = os.path.join(tmp_path, f"{name}.json")
+    if capsule:
+        cap_dir = os.path.join(tmp_path, f"{name}.capsule")
+        traces = os.path.join(cap_dir, "traces")
+        os.makedirs(traces)
+        with open(os.path.join(traces, "rsdl-telemetry-1000-0.jsonl"),
+                  "w") as f:
+            f.write(_trace_dump(reduce_s, n_epochs))
+        with open(os.path.join(cap_dir, "metrics.prom"), "w") as f:
+            f.write(_exposition(reduce_shifted, noise=noise))
+        with open(os.path.join(cap_dir, "policy.json"), "w") as f:
+            json.dump({"policy": policy or {"queue_maxsize": 4},
+                       "env": env or {}}, f)
+        with open(os.path.join(cap_dir, "capsule.json"), "w") as f:
+            json.dump({"schema": "rsdl-incident-v1",
+                       "reason": "bench-round"}, f)
+        record["capsule"] = f"{name}.capsule"
+    with open(record_path, "w") as f:
+        json.dump({"cmd": "test", "rc": 0, "n": 1, "parsed": record}, f)
+    return record_path
+
+
+# ---------------------------------------------------------------------------
+# Differential engine
+# ---------------------------------------------------------------------------
+
+
+def test_capsule_pair_names_planted_stage(tmp_path):
+    """The canonical forensic story: reduce 3x slower + its latency
+    histogram shifted + one env knob appeared -> reduce is suspect #1
+    with distribution corroboration, the knob is a ranked suspect."""
+    base = make_round(str(tmp_path), "base")
+    cur = make_round(str(tmp_path), "cur", value=640.0, reduce_s=0.30,
+                     reduce_shifted=True,
+                     env={"RSDL_PLANTED_KNOB": "1"})
+    report = regress.diff_rounds(base, cur)
+    assert report["mode"] == "capsule"
+    top = report["suspects"][0]
+    assert top["kind"] == "stage" and top["name"] == "reduce"
+    assert "distribution shifted" in top["evidence"]
+    assert any(s["kind"] == "env" and s["name"] == "RSDL_PLANTED_KNOB"
+               for s in report["suspects"])
+    reduce_row = next(r for r in report["critical_path_diff"]
+                      if r["stage"] == "reduce")
+    assert reduce_row["delta_ms_per_epoch"] == pytest.approx(200.0,
+                                                             abs=5.0)
+
+
+def test_stage_alignment_normalizes_epoch_count(tmp_path):
+    """A 4-epoch round diffs cleanly against a 2-epoch round: per-epoch
+    normalization keeps identical per-epoch stage times at ~zero delta,
+    so no stage suspect is invented from run length."""
+    base = make_round(str(tmp_path), "base", n_epochs=4)
+    cur = make_round(str(tmp_path), "cur", n_epochs=2)
+    report = regress.diff_rounds(base, cur)
+    assert report["mode"] == "capsule"
+    for row in report["critical_path_diff"]:
+        assert abs(row["delta_ms_per_epoch"]) < 1.0, row
+    assert not any(s["kind"] == "stage" for s in report["suspects"])
+
+
+def test_distribution_shift_flagged_noise_not(tmp_path):
+    """Bucket-overlap significance separates a real shape change (the
+    reduce mass moved buckets) from count jitter in the same buckets
+    (map_read gained two tail samples): only the former is significant."""
+    base = make_round(str(tmp_path), "base")
+    cur = make_round(str(tmp_path), "cur", reduce_shifted=True, noise=2)
+    report = regress.diff_rounds(base, cur)
+    by_stage = {row["labels"]["stage"]: row
+                for row in report["distribution_diff"]}
+    assert by_stage["reduce"]["significant"]
+    assert by_stage["reduce"]["shift_pct"] > 50
+    assert not by_stage["map_read"]["significant"]
+    assert by_stage["map_read"]["overlap"] > 0.9
+
+
+def test_bucket_overlap_bounds():
+    same = {0.1: 10.0, 0.2: 20.0}
+    assert regress.bucket_overlap(same, dict(same)) == pytest.approx(1.0)
+    disjoint = {0.1: 30.0, 0.2: 0.0}
+    other = {0.1: 0.0, 0.2: 30.0}
+    assert regress.bucket_overlap(disjoint, other) == pytest.approx(0.0)
+    assert regress.bucket_overlap({0.1: 1.0}, {0.2: 1.0}) is None
+
+
+def test_policy_and_env_diff(tmp_path):
+    base = make_round(str(tmp_path), "base",
+                      policy={"queue_maxsize": 4, "gone": 1})
+    cur = make_round(str(tmp_path), "cur",
+                     policy={"queue_maxsize": 8},
+                     env={"RSDL_NEW": "x"})
+    report = regress.diff_rounds(base, cur)
+    assert report["policy_diff"]["changed"]["queue_maxsize"] == [4, 8]
+    assert report["policy_diff"]["disappeared"] == {"gone": 1}
+    assert report["env_diff"]["appeared"] == {"RSDL_NEW": "x"}
+    names = {(s["kind"], s["name"]) for s in report["suspects"]}
+    assert ("policy", "queue_maxsize") in names
+    assert ("env", "RSDL_NEW") in names
+
+
+def test_capsule_less_pair_degrades_loudly(tmp_path):
+    """Records without capsules (the whole pre-r11 trajectory) still
+    produce a report: record-only mode, one explicit warning per
+    missing capsule, suspects from the largest record movers."""
+    base = make_round(str(tmp_path), "base", capsule=False,
+                      extra={"stream_rows_per_sec": 24000.0})
+    cur = make_round(str(tmp_path), "cur", value=900.0, capsule=False,
+                     extra={"stream_rows_per_sec": 12000.0})
+    report = regress.diff_rounds(base, cur)
+    assert report["mode"] == "record-only"
+    assert sum("NO flight capsule" in w
+               for w in report["warnings"]) == 2
+    assert report["suspects"]
+    assert report["suspects"][0]["kind"] == "metric"
+    assert report["suspects"][0]["name"] == "stream_rows_per_sec"
+    assert not report["critical_path_diff"]
+
+
+def test_provenance_warnings(tmp_path):
+    """Dirty trees and host-fingerprint mismatches are called out
+    before any delta is believed (the r09->r10 lesson: a slower host
+    reads exactly like a code regression)."""
+    base_p = {"git_rev": "a" * 40, "tree_dirty": False, "host": "h1",
+              "cpu_model": "Xeon 2.10GHz", "host_cpus": 1}
+    cur_p = {"git_rev": "b" * 40, "tree_dirty": True, "host": "h2",
+             "cpu_model": "EPYC 2.45GHz", "host_cpus": 1}
+    base = make_round(str(tmp_path), "base", capsule=False,
+                      provenance=base_p)
+    cur = make_round(str(tmp_path), "cur", capsule=False,
+                     provenance=cur_p)
+    warnings = regress.diff_rounds(base, cur)["warnings"]
+    assert any("DIRTY tree" in w for w in warnings)
+    assert any("CROSS-HOST" in w for w in warnings)
+    assert any("cpu_model" in w for w in warnings)
+    # include_missing=False keeps only the hard mismatches.
+    hard = regress.provenance_warnings({"value": 1}, {"value": 2},
+                                       include_missing=False)
+    assert hard == []
+
+
+def test_find_capsule_sibling_convention(tmp_path):
+    """A committed wrapper renamed after its round number finds the
+    capsule through the ``<stem>.capsule/`` sibling even when the
+    record's embedded reference is stale."""
+    path = make_round(str(tmp_path), "BENCH_r99")
+    _, record = regress.load_record(path)
+    record = dict(record, capsule="nonexistent-dir")
+    found = regress.find_capsule(path, record)
+    assert found == os.path.join(str(tmp_path), "BENCH_r99.capsule")
+    assert regress.find_capsule(
+        os.path.join(str(tmp_path), "missing.json"), {}) is None
+
+
+def test_self_check_names_planted_suspect():
+    ok, lines = regress.self_check()
+    assert ok, "\n".join(lines)
+    assert any("reduce" in line for line in lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI (subprocess: the tool must load runtime/regress.py by path)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_cli_smoke(tmp_path):
+    tool = os.path.join(REPO_ROOT, "tools", "rsdl_regress.py")
+    base = make_round(str(tmp_path), "base")
+    cur = make_round(str(tmp_path), "cur", value=640.0, reduce_s=0.30,
+                     reduce_shifted=True,
+                     env={"RSDL_PLANTED_KNOB": "1"})
+    out = subprocess.run([sys.executable, tool, base, cur],
+                         capture_output=True, text=True, timeout=120)
+    assert out.returncode == 0, out.stderr
+    assert "#1 [stage] reduce" in out.stdout
+    as_json = subprocess.run([sys.executable, tool, base, cur, "--json"],
+                             capture_output=True, text=True, timeout=120)
+    assert as_json.returncode == 0, as_json.stderr
+    report = json.loads(as_json.stdout)
+    assert report["schema"] == "rsdl-regress-v1"
+    assert report["suspects"][0]["name"] == "reduce"
+    check = subprocess.run([sys.executable, tool, "--check"],
+                           capture_output=True, text=True, timeout=120)
+    assert check.returncode == 0, check.stdout + check.stderr
+    assert "planted suspect ranked #1" in check.stdout
+    missing = subprocess.run(
+        [sys.executable, tool, os.path.join(str(tmp_path), "nope.json"),
+         base], capture_output=True, text=True, timeout=120)
+    assert missing.returncode == 2

@@ -255,6 +255,47 @@ def _latest_records(directory: str) -> List[str]:
     return paths[-2:]
 
 
+def _load_regress():
+    """runtime/regress.py by file path (fail-soft: the gate's verdict
+    never depends on the forensics plane loading)."""
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(root, "ray_shuffling_data_loader_tpu",
+                        "runtime", "regress.py")
+    spec = importlib.util.spec_from_file_location("_rsdl_regress", path)
+    module = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(module)
+    return module
+
+
+def forensic_lines(base_path: str, cur_path: str) -> List[str]:
+    """The differential forensics footer printed under a failed gate:
+    the runtime/regress.py suspect ranking when both records carry
+    flight capsules, its loud record-only degrade when they don't.
+    Never raises — thresholds and exit codes stay this tool's only
+    contract; the footer is evidence, not verdict."""
+    try:
+        regress = _load_regress()
+        report = regress.diff_rounds(base_path, cur_path)
+        return regress.render_report(report)
+    except Exception as e:  # noqa: BLE001 - evidence, not verdict
+        return [f"forensics unavailable: {type(e).__name__}: {e}"]
+
+
+def provenance_lines(base: Dict[str, Any],
+                     cur: Dict[str, Any]) -> List[str]:
+    """Hard comparability warnings (dirty tree, cross-host) from the
+    records' provenance stamps — printed even when every threshold
+    passes, because a cross-host pair passing the gate is as misleading
+    as one failing it (the r09->r10 lesson)."""
+    try:
+        regress = _load_regress()
+        return [f"WARNING {w}" for w in regress.provenance_warnings(
+            base, cur, include_missing=False)]
+    except Exception:  # noqa: BLE001 - evidence, not verdict
+        return []
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         description="per-metric regression gate between two bench records")
@@ -315,10 +356,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                           "regressed": len(regressions)}))
     else:
         print(f"bench-diff: {base_path} -> {cur_path}")
+        for line in provenance_lines(base, cur):
+            print(f"  {line}")
         for line in render_findings(findings):
             print(f"  {line}")
         if regressions:
             print(f"  {len(regressions)} metric(s) REGRESSED")
+            for line in forensic_lines(base_path, cur_path):
+                print(f"  {line}")
     return 1 if regressions and hard else 0
 
 

@@ -1,0 +1,114 @@
+#!/usr/bin/env python
+"""rsdl-regress: differential forensics between two bench rounds.
+
+``tools/rsdl_bench_diff.py`` tells you *that* ``train_rows_per_sec``
+dropped 20%; this tool tells you *why*, from the flight capsules
+``bench.py`` writes beside each ``BENCH_r*.json`` record: which stage's
+critical-path share grew (per-epoch normalized, so rounds with
+different epoch counts align), which latency distribution actually
+shifted shape (bucket-overlap significance over the committed
+histogram/sketch buckets — not just a mean), which resolved policy knob
+or ``RSDL_*`` env var appeared/changed, and a ranked suspect list with
+what-if attribution.
+
+Usage::
+
+    tools/rsdl_regress.py BENCH_r10.json BENCH_r11.json
+    tools/rsdl_regress.py BENCH_r09.json BENCH_r10.json   # no capsules:
+                                        # loud record-only degrade
+    tools/rsdl_regress.py base.json cur.json --json       # full report
+    tools/rsdl_regress.py --check     # self-test: synthesizes a round
+                                      # pair with a planted suspect and
+                                      # requires the ranking to name it
+
+Exit codes: 0 report produced (even record-only), 2 inputs unusable.
+``--check`` exits 1 if the planted suspect is not ranked first.
+
+Stdlib-only: loads ``runtime/regress.py`` by file path (the rsdl_top
+pattern), so it runs on hosts without numpy/pyarrow/jax — an operator
+laptop holding two downloaded records and capsules.
+"""
+
+import argparse
+import importlib.util
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_RUNTIME = os.path.join(_REPO_ROOT, "ray_shuffling_data_loader_tpu",
+                        "runtime")
+
+
+def _load_regress():
+    try:
+        import importlib
+        return importlib.import_module(
+            "ray_shuffling_data_loader_tpu.runtime.regress")
+    except ImportError:
+        spec = importlib.util.spec_from_file_location(
+            "_rsdl_regress", os.path.join(_RUNTIME, "regress.py"))
+        module = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(module)
+        return module
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="rsdl_regress",
+        description="differential forensics between two bench rounds")
+    parser.add_argument("base", nargs="?",
+                        help="baseline bench record (raw or BENCH_r* "
+                             "wrapper)")
+    parser.add_argument("cur", nargs="?",
+                        help="current bench record to explain")
+    parser.add_argument("--json", action="store_true",
+                        help="emit the full report as JSON instead of "
+                             "the rendered summary")
+    parser.add_argument("--max-suspects", type=int, default=8,
+                        help="suspect list length (default 8)")
+    parser.add_argument("--whatif-speedup", type=float, default=2.0,
+                        help="what-if speedup factor for the current "
+                             "round's trace attribution (default 2.0)")
+    parser.add_argument("--check", action="store_true",
+                        help="self-test: plant a known suspect in a "
+                             "synthetic round pair and verify the "
+                             "ranking names it")
+    args = parser.parse_args(argv)
+
+    regress = _load_regress()
+
+    if args.check:
+        ok, lines = regress.self_check()
+        for line in lines:
+            print(line)
+        print("rsdl_regress --check: %s" % (
+            "planted suspect ranked #1" if ok
+            else "FAILED (planted suspect not ranked #1)"))
+        return 0 if ok else 1
+
+    if not args.base or not args.cur:
+        parser.error("two record paths required (or --check)")
+    for path in (args.base, args.cur):
+        if not os.path.isfile(path):
+            print(f"rsdl_regress: no such record: {path}",
+                  file=sys.stderr)
+            return 2
+    try:
+        report = regress.diff_rounds(
+            args.base, args.cur,
+            whatif_speedup=args.whatif_speedup,
+            max_suspects=args.max_suspects)
+    except (ValueError, OSError, json.JSONDecodeError) as e:
+        print(f"rsdl_regress: {e}", file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps(report, indent=2, sort_keys=True))
+    else:
+        for line in regress.render_report(report):
+            print(line)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -223,6 +223,27 @@ def _table(headers, rows) -> str:
     return f"<table><thead><tr>{head}</tr></thead><tbody>{body}</tbody></table>"
 
 
+def _biggest_change(prev, rec) -> str:
+    """One phrase per trajectory row: the largest relative move among
+    the numeric keys two consecutive rounds share (the per-round 'what
+    changed vs previous' cell — the forensic headline, with the full
+    story in `tools/rsdl_regress.py prev cur`)."""
+    best_key, best_pct = None, 0.0
+    for key in set(prev) & set(rec):
+        p, c = prev.get(key), rec.get(key)
+        if isinstance(p, bool) or isinstance(c, bool):
+            continue
+        if not isinstance(p, (int, float)) or \
+                not isinstance(c, (int, float)) or p == 0:
+            continue
+        pct = 100.0 * (c - p) / abs(p)
+        if abs(pct) > abs(best_pct):
+            best_key, best_pct = key, pct
+    if best_key is None or abs(best_pct) < 2.0:
+        return "–"
+    return f"{html.escape(best_key)} {best_pct:+.0f}%"
+
+
 def _section_bench(records) -> str:
     if not records:
         return ""
@@ -236,6 +257,7 @@ def _section_bench(records) -> str:
     pts = [(r, rec.get("value", 0.0)) for r, rec in records]
     parts.append(spark_svg(pts, unit=" rows/s"))
     rows = []
+    prev_rec = None
     for r, rec in records:
         health = rec.get("health") or {}
         fires = health.get("fires")
@@ -247,10 +269,12 @@ def _section_bench(records) -> str:
             html.escape(str(rec.get("executor_backend") or "–")),
             ("<span class='breach'>" + str(fires) + " FIRED</span>"
              if fires else ("0" if fires == 0 else "–")),
+            (_biggest_change(prev_rec, rec) if prev_rec else "–"),
         ))
+        prev_rec = rec
     parts.append(_table(
         ("round", "rows/s", "stall %", "mfu %", "bottleneck", "backend",
-         "health fires"), rows))
+         "health fires", "vs prev"), rows))
     return "".join(parts)
 
 
