@@ -909,6 +909,17 @@ def _capture_round_capsule(record: dict) -> "str | None":
     from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
     base_dir = rt_policy.resolve("bench", "bench_capsule_dir") or "."
     stem = f"bench-{os.getpid()}-r.capsule"
+    # Pin a scratch trace dir ONLY for the capture window (all timed
+    # phases are over): capture_incident signals the driver to dump its
+    # flight-recorder ring and collects whatever lands in the resolved
+    # trace dir. A run-long pin would also catch worker exit dumps, but
+    # costs measured-phase CPU/IO — the workers' events already fold
+    # into the driver-side attribution summary, so the trade is bad.
+    pinned = None
+    if not rt_policy.resolve("telemetry", "trace_dir"):
+        import tempfile
+        pinned = tempfile.mkdtemp(prefix="rsdl-bench-trace-")
+        os.environ["RSDL_TRACE_DIR"] = pinned
     try:
         capsule = rt_health.capture_incident(
             reason="bench-round", base_dir=base_dir, profile_s=0.0,
@@ -917,6 +928,11 @@ def _capture_round_capsule(record: dict) -> "str | None":
         print(f"# bench capsule capture FAILED: {type(e).__name__}: {e}",
               file=sys.stderr)
         return None
+    finally:
+        if pinned is not None:
+            os.environ.pop("RSDL_TRACE_DIR", None)
+            import shutil
+            shutil.rmtree(pinned, ignore_errors=True)
     if capsule is None:
         return None
     try:
@@ -2347,16 +2363,15 @@ def main() -> None:
     rt_tel.install_signal_dump()
     rt_health.install_incident_signal()
     rt_metrics.maybe_start_shard_writer()
-    # Per-round flight capsule (runtime/regress.py): capture collects
-    # sibling trace dumps from the shared RSDL_TRACE_DIR, so it is
-    # pinned BEFORE any worker pool forks (children inherit it via the
-    # environment). RSDL_BENCH_CAPSULE=0 skips both, restoring the
-    # pre-capsule bench byte for byte.
+    # Per-round flight capsule (runtime/regress.py), captured after the
+    # last phase. No trace dir is pinned here: arming RSDL_TRACE_DIR for
+    # the whole run makes every pool worker write an exit dump and
+    # routes mid-run incident dumps to disk — measurable perturbation of
+    # the serve/remote legs on the 1-core host. The capture itself pins
+    # a scratch dir only for the duration of the dump (see
+    # _capture_round_capsule). RSDL_BENCH_CAPSULE=0 skips capture,
+    # restoring the pre-capsule bench byte for byte.
     bench_capsule = rt_policy.resolve("bench", "bench_capsule")
-    if bench_capsule and not rt_policy.resolve("telemetry", "trace_dir"):
-        import tempfile
-        os.environ["RSDL_TRACE_DIR"] = tempfile.mkdtemp(
-            prefix="rsdl-bench-trace-")
     if (rt_policy.resolve("metrics", "metrics_file")
             or rt_policy.resolve("metrics", "metrics_port")):
         rt_metrics.start_exporter()
