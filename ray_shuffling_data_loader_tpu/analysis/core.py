@@ -158,6 +158,22 @@ class Config:
     fixed_world_exempt_globs: Tuple[str, ...] = (
         "*ray_shuffling_data_loader_tpu/membership/*",
         "*ray_shuffling_data_loader_tpu/plan/*")
+    # fnmatch patterns of library files where literal queue->shard
+    # arithmetic (.. % num_shards) or indexed shard-address lookups
+    # (shard_map.addresses[shard]) are a shard-affinity-assumption
+    # violation — placement moves under live rebalancing (rebalance/),
+    # so routing must query ShardMap.shard_for_queue /
+    # address_for_queue at call time.
+    shard_affinity_globs: Tuple[str, ...] = (
+        "ray_shuffling_data_loader_tpu/*",)
+    # Exempt: plan/ owns the placement arithmetic, rebalance/ journals
+    # and rewrites it, and the serving plane implements the MOVED
+    # redirect protocol itself (its cached routes are invalidated by
+    # the redirect, by construction).
+    shard_affinity_exempt_globs: Tuple[str, ...] = (
+        "*ray_shuffling_data_loader_tpu/plan/*",
+        "*ray_shuffling_data_loader_tpu/rebalance/*",
+        "*ray_shuffling_data_loader_tpu/multiqueue_service.py")
     # fnmatch patterns of files included in the whole-program
     # concurrency pass (--concurrency). Library code only: tests spin
     # throwaway threads/locks with no cross-module ordering contract.

@@ -198,6 +198,71 @@ class StaticEpochAssumptionRule(Rule):
 
 
 @register
+class ShardAffinityAssumptionRule(Rule):
+    id = "shard-affinity-assumption"
+    category = "plan"
+    description = ("library code deriving queue->shard placement with "
+                   "literal num_shards arithmetic or resolving/caching a "
+                   "shard's (host, port) by index — placement moves "
+                   "under live rebalancing (rebalance/), so routing must "
+                   "query ShardMap.shard_for_queue / address_for_queue "
+                   "at call time")
+
+    def check(self, tree: ast.Module,
+              ctx: FileContext) -> Iterator[Violation]:
+        if not ctx.path_matches(ctx.config.shard_affinity_globs):
+            return
+        if ctx.path_matches(ctx.config.shard_affinity_exempt_globs):
+            return
+        for node in ast.walk(tree):
+            if isinstance(node, ast.BinOp):
+                violation = self._check_binop(node, ctx)
+                if violation is not None:
+                    yield violation
+            elif isinstance(node, ast.Subscript):
+                violation = self._check_subscript(node, ctx)
+                if violation is not None:
+                    yield violation
+
+    def _check_binop(self, node: ast.BinOp, ctx: FileContext):
+        # `rank % num_shards` / `q // num_shards` / `x * num_shards`:
+        # the STATIC placement formula. Correct on a fresh plan, stale
+        # the moment a committed migration installs an override — the
+        # consumer keeps dialing the pre-move shard and eats a failure
+        # frame (or worse, a zombie's stream).
+        if not isinstance(node.op, (ast.Mod, ast.FloorDiv, ast.Mult)):
+            return None
+        sides = ([node.left, node.right]
+                 if isinstance(node.op, ast.Mult) else [node.right])
+        for side in sides:
+            if _mentions(_name_words(side), "num_shards"):
+                return ctx.violation(
+                    self, node,
+                    "queue->shard placement derived with literal "
+                    "num_shards arithmetic; query plan.ir.ShardMap."
+                    "shard_for_queue/shard_for_rank — overrides from "
+                    "live rebalancing make the static formula stale")
+        return None
+
+    def _check_subscript(self, node: ast.Subscript, ctx: FileContext):
+        # `shard_map.addresses[shard]`: a shard address resolved by
+        # index — the caller is about to cache a (host, port) that a
+        # committed migration invalidates. `address_for_queue` (or the
+        # MOVED-following ShardedRemoteQueue) re-resolves per call.
+        words = _name_words(node.value)
+        if not _mentions(words, "addresses"):
+            return None
+        if not _mentions(_name_words(node.slice), "shard"):
+            return None
+        return ctx.violation(
+            self, node,
+            "shard (host, port) resolved by address-table index; use "
+            "plan.ir.ShardMap.address_for_queue (or route through "
+            "ShardedRemoteQueue, which follows MOVED redirects) — "
+            "cached shard addresses go stale under live rebalancing")
+
+
+@register
 class FixedWorldAssumptionRule(Rule):
     id = "fixed-world-assumption"
     category = "plan"

@@ -156,6 +156,7 @@ sketch, labeled by trainer rank. Latency honesty across failure:
 
 from __future__ import annotations
 
+import base64
 import collections
 import concurrent.futures as cf
 import itertools
@@ -191,10 +192,16 @@ logger = setup_custom_logger(__name__)
 
 _REQUEST = struct.Struct("<BBIII")
 _BATCH_HEADER = struct.Struct("<I")
-#: v3.1 frame header: (kind|codec<<4, epoch, seq, crc, row_offset,
+#: v3.3 frame header: (kind|codec<<4, epoch, seq, crc, row_offset,
 #: length, task) + the delivery-latency stamps — birth (t_mono, t_unix,
-#: pid) then queued (t_mono, t_unix, pid); all-zero stamp = unknown.
-_FRAME = struct.Struct("<BIIIQQIddIddI")
+#: pid) then queued (t_mono, t_unix, pid); all-zero stamp = unknown —
+#: then the placement ``generation`` (rebalance/): the fence a consumer
+#: compares against its per-rank floor, so a zombie source shard's
+#: post-migration frames are loudly droppable (the membership
+#: incarnation-fencing idiom applied to queue placement). Pre-rebalance
+#: servers stamp 0 and pre-rebalance clients never raise their floor,
+#: so the fence is inert until a move commits.
+_FRAME = struct.Struct("<BIIIQQIddIddII")
 
 
 def _pack_stamp(stamp) -> tuple:
@@ -220,6 +227,22 @@ OP_NACK = 4
 #: v3.2: bind a consumer lease to a TenantContext (a|b<<32 = consumer
 #: id, c = length of the JSON blob following the request struct).
 OP_TENANT = 5
+#: v3.3: rebalance admin verb (rebalance/). ``flags`` is the phase
+#: (REB_*), ``a`` = trainer rank, ``b`` = placement generation, ``c`` =
+#: length of the JSON payload following the request. The response is a
+#: u32 length + a ``checkpoint.crc_line`` JSON payload (the handoff
+#: manifest for PREPARE; an ack/error blob otherwise).
+OP_REBALANCE = 6
+
+#: OP_REBALANCE phases: PREPARE seals the rank at a watermark and
+#: exports the CRC'd handoff manifest; ADOPT imports it on the target
+#: at the new generation (journaled — the durable half of COMMIT);
+#: RELEASE drops the rank on the source and arms MOVED redirects;
+#: UNSEAL is the abort path (source resumes, authoritative).
+REB_PREPARE = 1
+REB_ADOPT = 2
+REB_RELEASE = 3
+REB_UNSEAL = 4
 
 FLAG_RESUME = 1
 #: OP_HELLO flag: the consumer can mmap paths on the server's host
@@ -233,6 +256,13 @@ KIND_FAILURE = 2
 #: Table delivered as a shm segment handle (payload = JSON blob with
 #: path/offset/size/crc); the header CRC covers the blob itself.
 KIND_TABLE_HANDLE = 3
+#: v3.3 redirect (rebalance/): the queue's rank migrated to another
+#: shard. Payload = JSON blob with host/port/generation/rank; the
+#: header CRC covers the blob and the header generation carries the
+#: new placement generation (the consumer raises its fence floor
+#: BEFORE redialing, so the old home's stale frames can never race in
+#: after the redirect).
+KIND_MOVED = 4
 
 #: High nibble of the frame kind byte: payload codec.
 _KIND_MASK = 0x0F
@@ -534,6 +564,28 @@ class _Lease:
         self.tenant: Optional[str] = None
 
 
+class QueueMoved(Exception):
+    """A GET hit a queue whose rank migrated to another shard (the
+    server answered with a ``KIND_MOVED`` redirect). Carries everything
+    a router needs to follow: the new ``address`` and the committed
+    placement ``generation`` (the consumer's fence floor is already
+    raised when this is thrown). :class:`ShardedRemoteQueue` handles it
+    transparently; a bare :class:`RemoteQueue` surfaces it — a consumer
+    that cached a ``(host, port)`` is exactly what the
+    ``shard-affinity-assumption`` lint rule exists to catch."""
+
+    def __init__(self, queue_index: int, rank: int,
+                 address: Tuple[str, int], generation: int):
+        super().__init__(
+            f"queue {queue_index} (rank {rank}) moved to "
+            f"{address[0]}:{address[1]} at placement generation "
+            f"{generation}")
+        self.queue_index = queue_index
+        self.rank = rank
+        self.address = (str(address[0]), int(address[1]))
+        self.generation = generation
+
+
 _POP_CLOSED = object()
 _POP_EMPTY = object()
 
@@ -573,13 +625,50 @@ class QueueServer:
                  exit_on_crash_site: bool = False,
                  shard_index: int = 0, num_shards: int = 1,
                  handle_dir: Optional[str] = None,
-                 tenants: Optional[dict] = None):
+                 tenants: Optional[dict] = None,
+                 placement: Optional[dict] = None):
         self._queue = queue
         self._num_trainers = max(1, num_trainers)
         self._journal = journal
         self._exit_on_crash_site = exit_on_crash_site
         self._shard_index = shard_index
         self._num_shards = max(1, num_shards)
+        # -- live-migration placement plane (rebalance/). ``placement``
+        # is the serialized state the controller journals:
+        # ``{"generation": G, "overrides": {rank: shard},
+        #    "rank_generations": {rank: gen}, "addresses": [[h, p]..]}``.
+        # A rank whose override routes it *here* is adopted
+        # (``_extra_ranks``); a rank that statically belongs here but is
+        # overridden *away* answers GETs with a ``KIND_MOVED`` redirect
+        # (``_moved``). ``_rank_gen`` is stamped into every outbound
+        # frame header — the fence that makes a zombie source's
+        # post-move frames loudly droppable at the consumer.
+        placement = placement or {}
+        self._placement_gen = int(placement.get("generation", 0))
+        self._rank_gen: Dict[int, int] = {
+            int(r): int(g)
+            for r, g in dict(placement.get("rank_generations", {})).items()}
+        self._sealed_ranks: set = set()
+        self._extra_ranks: set = set()
+        self._moved: Dict[int, Tuple[int, Tuple[str, int]]] = {}
+        addresses = [tuple(a) for a in placement.get("addresses", ())]
+        for r, s in dict(placement.get("overrides", {})).items():
+            rank, shard_for_rank = int(r), int(s)
+            static = rank % self._num_shards
+            if shard_for_rank == static:
+                continue
+            if shard_for_rank == self._shard_index:
+                self._extra_ranks.add(rank)
+            elif static == self._shard_index:
+                if shard_for_rank >= len(addresses):
+                    raise ValueError(
+                        f"placement override routes rank {rank} to shard "
+                        f"{shard_for_rank} but only {len(addresses)} "
+                        f"addresses were supplied")
+                self._moved[rank] = (
+                    self._rank_gen.get(rank, self._placement_gen),
+                    (str(addresses[shard_for_rank][0]),
+                     int(addresses[shard_for_rank][1])))
         self._timeout_s = rt_policy.resolve("queue", "queue_timeout_s")
         self._nodelay = rt_policy.resolve("queue", "queue_nodelay")
         self._replay_budget = rt_policy.resolve("queue",
@@ -746,12 +835,23 @@ class QueueServer:
         (and the consumer's lease) stay live while the queue is idle.
         ``mq.ShutdownError`` (the QUEUE shut down, not this server)
         propagates so the consumer gets a loud failure frame."""
+        rank = plan_ir.queue_rank(queue_idx, self._num_trainers)
         while not self._closed.is_set():
             try:
                 return self._queue.get(queue_idx, block=blocking,
                                        timeout=0.25 if blocking else None)
             except mq.Empty:
                 if not blocking:
+                    return _POP_EMPTY
+                if rank in self._sealed_ranks:
+                    # The rank was PREPARE-sealed while this GET was
+                    # parked on an idle live stream. The caller holds
+                    # the queue's state lock, which the migration's
+                    # export needs to snapshot the replay suffix — so
+                    # give the lock back with an empty batch (the
+                    # consumer refetches and lands on the seal path /
+                    # MOVED redirect) instead of stalling PREPARE
+                    # behind the next produced item.
                     return _POP_EMPTY
                 # A consumer blocked in a server-side GET is alive by
                 # definition — beat its lease while it waits.
@@ -764,6 +864,11 @@ class QueueServer:
         return plan_ir.queue_epoch(queue_idx, self._num_trainers)
 
     def _owns_queue(self, queue_idx: int) -> bool:
+        rank = plan_ir.queue_rank(queue_idx, self._num_trainers)
+        if rank in self._moved:
+            return False
+        if rank in self._extra_ranks:
+            return True
         return (self._num_shards <= 1
                 or plan_ir.queue_shard(queue_idx, self._num_trainers,
                                        self._num_shards)
@@ -1080,6 +1185,8 @@ class QueueServer:
                 # round-robin accounting.
                 time.sleep(self._floor_pace_s)
         state = self._state(queue_idx)
+        rank = plan_ir.queue_rank(queue_idx, self._num_trainers)
+        sealed = rank in self._sealed_ranks
         with state.lock:
             want_handle = handles_ok and not state.no_handles
             if ack is not None and ack > state.acked_seq:
@@ -1106,7 +1213,11 @@ class QueueServer:
                 rt_telemetry.record("frame_replay", epoch=frames[0].epoch,
                                     task=queue_idx, count=len(frames))
             try:
-                while (len(frames) < max_items
+                # A PREPARE-sealed rank serves ONLY its replay suffix —
+                # the handoff manifest snapshotted everything past the
+                # watermark, so popping anything new here would fork the
+                # stream the target is about to adopt.
+                while (not sealed and len(frames) < max_items
                        and (not frames
                             or frames[-1].kind in (KIND_TABLE,
                                                    KIND_TABLE_HANDLE))):
@@ -1167,6 +1278,12 @@ class QueueServer:
                             self._compression_saved.inc(-delta)
             if frames:
                 state.sent_seq = frames[-1].seq
+        if sealed and not frames:
+            # Pace a consumer polling a sealed-and-drained queue: an
+            # empty batch is a valid response (the client just refetches)
+            # but an unpaced loop would spin the loopback until the
+            # MOVED redirect or an UNSEAL lands.
+            time.sleep(0.05)
         self._note_shard_depth()
         return frames
 
@@ -1180,6 +1297,8 @@ class QueueServer:
         torn header flushes exactly the bytes the sequential path would
         have pushed before the injected reset."""
         gather = self._sendmsg and hasattr(conn, "sendmsg")
+        gen = self._rank_gen.get(
+            plan_ir.queue_rank(queue_idx, self._num_trainers), 0)
         vecs: List = [_BATCH_HEADER.pack(len(frames))]
         if not gather:
             conn.sendall(vecs[0])
@@ -1191,7 +1310,7 @@ class QueueServer:
                                  frame.crc, frame.row_offset, size,
                                  frame.task,
                                  *_pack_stamp(frame.birth),
-                                 *_pack_stamp(frame.queued))
+                                 *_pack_stamp(frame.queued), gen)
             try:
                 rt_faults.inject("conn_reset_midframe", epoch=frame.epoch,
                                  task=queue_idx)
@@ -1257,11 +1376,28 @@ class QueueServer:
 
     def _fail_frame(self, text: bytes) -> bytes:
         """A one-frame failure response (v2 shape: count + header +
-        payload)."""
+        payload). Failure frames stamp placement generation 0 — they
+        are exempt from the consumer's fence so an error always lands,
+        even from a zombie."""
         return (_BATCH_HEADER.pack(1)
                 + _FRAME.pack(KIND_FAILURE, 0, ACK_NONE, _crc(text), 0,
                               len(text), TASK_NONE, 0.0, 0.0, 0,
-                              0.0, 0.0, 0) + text)
+                              0.0, 0.0, 0, 0) + text)
+
+    def _moved_frame(self, queue_idx: int, rank: int) -> bytes:
+        """A one-frame ``KIND_MOVED`` redirect: the JSON payload carries
+        the adopting shard's address and the committed placement
+        generation; the header's generation field repeats it so the
+        consumer raises its fence floor before it ever dials the new
+        address."""
+        generation, (host, port) = self._moved[rank]
+        blob = json.dumps({"host": host, "port": port,
+                           "generation": generation, "rank": rank},
+                          sort_keys=True).encode()
+        return (_BATCH_HEADER.pack(1)
+                + _FRAME.pack(KIND_MOVED, 0, ACK_NONE, _crc(blob), 0,
+                              len(blob), TASK_NONE, 0.0, 0.0, 0,
+                              0.0, 0.0, 0, generation) + blob)
 
     def _serve_conn(self, conn: socket.socket) -> None:
         consumer_id: Optional[int] = None
@@ -1294,9 +1430,22 @@ class QueueServer:
                     self._handle_nack(a, b, c)
                     self._lease_beat(consumer_id, a)
                     continue
+                if op == OP_REBALANCE:
+                    blob = _recv_exact(conn, c) if c else b""
+                    payload = self._rebalance_admin(flags, a, b, blob)
+                    conn.sendall(_BATCH_HEADER.pack(len(payload)) + payload)
+                    continue
                 if op != OP_GET_BATCH:
                     raise ConnectionError(f"unknown request op {op}")
                 queue_idx, max_items = a, b
+                moved_rank = plan_ir.queue_rank(queue_idx,
+                                                self._num_trainers)
+                if moved_rank in self._moved:
+                    # This rank migrated away under a committed placement
+                    # decision: answer with a redirect (new address +
+                    # generation), never a foreign-rank stream.
+                    conn.sendall(self._moved_frame(queue_idx, moved_rank))
+                    continue
                 if not self._owns_queue(queue_idx):
                     # Routing bug (a consumer dialing the wrong shard)
                     # must fail loudly, not serve a foreign rank's
@@ -1358,6 +1507,224 @@ class QueueServer:
                             task=queue_idx, seq=bad_seq)
         logger.warning("queue %d: consumer NACK'd frame %d (CRC mismatch); "
                        "re-sending from replay", queue_idx, bad_seq)
+
+    # -- live queue migration (rebalance/) ----------------------------------
+
+    def _rank_queues(self, rank: int) -> List[int]:
+        """Every queue index of ``rank`` that has server-side state
+        (``queue_id = epoch * num_trainers + rank``)."""
+        with self._states_lock:
+            return sorted(q for q in self._states
+                          if plan_ir.queue_rank(q, self._num_trainers)
+                          == rank)
+
+    def _crash_site(self, site: str, generation: int, rank: int) -> None:
+        """One injected chaos site = the whole server process dying at
+        this exact migration phase (same recovery unit as
+        ``queue_server_crash``)."""
+        try:
+            rt_faults.inject(site, epoch=generation, task=rank)
+        except rt_faults.InjectedFault:
+            if self._exit_on_crash_site:
+                os._exit(137)
+            self.close()
+            raise
+
+    def _rebalance_admin(self, phase: int, rank: int, generation: int,
+                         payload: bytes) -> bytes:
+        """Dispatch one OP_REBALANCE phase. Every response is a
+        ``checkpoint.crc_line`` JSON payload; errors come back as
+        ``{"error": ...}`` lines so the driver can abort cleanly instead
+        of eating a connection reset."""
+        from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+        try:
+            if phase == REB_PREPARE:
+                self._crash_site("rebalance_prepare", generation, rank)
+                line = self._export_rank(rank, generation)
+                rt_telemetry.record("rebalance_prepare", epoch=generation,
+                                    task=rank, shard=self._shard_index)
+                return line
+            if phase == REB_ADOPT:
+                self._crash_site("rebalance_commit", generation, rank)
+                # Verify the manifest line's CRC HERE, on the adopting
+                # shard: the driver ships the source's crc_line verbatim,
+                # so corruption anywhere on the path is caught before a
+                # single byte of state is installed.
+                manifest = ckpt.parse_crc_line(
+                    payload.decode("utf-8"))["manifest"]
+                self._import_rank(manifest)
+                rt_telemetry.record("rebalance_commit", epoch=generation,
+                                    task=rank, shard=self._shard_index)
+                return ckpt.crc_line({"adopted": rank,
+                                      "generation": generation}).encode()
+            if phase == REB_RELEASE:
+                target = json.loads(payload.decode("utf-8"))
+                self._release_rank(rank, generation,
+                                   (str(target["host"]),
+                                    int(target["port"])))
+                rt_telemetry.record("rebalance_release", epoch=generation,
+                                    task=rank, shard=self._shard_index)
+                return ckpt.crc_line({"released": rank,
+                                      "generation": generation}).encode()
+            if phase == REB_UNSEAL:
+                self._sealed_ranks.discard(rank)
+                rt_telemetry.record("rebalance_unseal", epoch=generation,
+                                    task=rank, shard=self._shard_index)
+                return ckpt.crc_line({"unsealed": rank}).encode()
+            return ckpt.crc_line(
+                {"error": f"unknown rebalance phase {phase}"}).encode()
+        except rt_faults.InjectedFault:
+            raise
+        except Exception as e:  # noqa: BLE001 - reported to the driver
+            logger.warning("rebalance phase %d for rank %d failed: %s",
+                           phase, rank, e)
+            return ckpt.crc_line({"error": repr(e)}).encode()
+
+    def _export_rank(self, rank: int, generation: int) -> bytes:
+        """PREPARE: seal ``rank`` at its watermark and export everything
+        a target shard needs to continue its streams exactly-once — per
+        queue the sequence cursor, row accounting, journal birth stamps,
+        and the full unacked replay suffix as base64 byte frames (handle
+        frames are downgraded first: a foreign shard cannot mmap this
+        host's shm segments). The whole manifest rides one
+        ``checkpoint.crc_line`` so it is tamper-evident end to end."""
+        from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+        self._sealed_ranks.add(rank)
+        queues: Dict[str, dict] = {}
+        for q in self._rank_queues(rank):
+            state = self._state(q)
+            with state.lock:
+                frames = []
+                for frame in state.replay:
+                    if frame.pending_codec is not None:
+                        state.replay_bytes += frame.resolve_codec()
+                    if frame.kind == KIND_TABLE_HANDLE:
+                        frame = self._downgrade_frame(frame)
+                    frames.append({
+                        "seq": frame.seq, "kind": frame.kind,
+                        "epoch": frame.epoch, "crc": frame.crc,
+                        "data_crc": frame.data_crc,
+                        "row_offset": frame.row_offset,
+                        "nrows": frame.nrows, "task": frame.task,
+                        "codec": frame.codec,
+                        "payload_bytes": frame.payload_bytes,
+                        "wire": base64.b64encode(
+                            bytes(memoryview(frame.wire))).decode("ascii"),
+                        "birth": list(frame.birth) if frame.birth else None,
+                        "queued": (list(frame.queued)
+                                   if frame.queued else None),
+                    })
+                queues[str(q)] = {
+                    "next_seq": state.next_seq,
+                    "acked_seq": state.acked_seq,
+                    "acked_rows": state.acked_rows,
+                    "rows_total": state.rows_total,
+                    "done": state.done,
+                    "births": {str(seq): list(stamp)
+                               for seq, stamp in state.births.items()},
+                    "frames": frames,
+                }
+        manifest = {"rank": rank, "generation": generation,
+                    "num_trainers": self._num_trainers,
+                    "source_shard": self._shard_index,
+                    "queues": queues}
+        return ckpt.crc_line({"manifest": manifest}).encode()
+
+    def _import_rank(self, manifest: dict) -> None:
+        """COMMIT: install an exported rank's queue states (idempotent —
+        re-adopting the same generation is a no-op) and merge its
+        watermarks into this shard's journal, so even a restart of the
+        TARGET after adoption regenerates exactly the undelivered
+        remainder through the normal resume machinery."""
+        rank = int(manifest["rank"])
+        generation = int(manifest["generation"])
+        if int(manifest["num_trainers"]) != self._num_trainers:
+            raise ValueError(
+                f"manifest num_trainers {manifest['num_trainers']} != "
+                f"server num_trainers {self._num_trainers}")
+        if self._rank_gen.get(rank, 0) >= generation > 0:
+            logger.warning("rank %d already adopted at generation >= %d; "
+                           "treating re-adopt as a no-op", rank, generation)
+            return
+        for q_str, entry in manifest["queues"].items():
+            q = int(q_str)
+            births = {
+                int(seq): rt_lat.Stamp(int(pid), float(tm), float(tu))
+                for seq, (pid, tm, tu) in entry["births"].items()}
+            state = _QueueState(next_seq=int(entry["next_seq"]),
+                                done=bool(entry["done"]), births=births)
+            state.acked_seq = int(entry["acked_seq"])
+            state.sent_seq = state.acked_seq
+            state.acked_rows = int(entry["acked_rows"])
+            state.rows_total = int(entry["rows_total"])
+            for f in entry["frames"]:
+                birth = (rt_lat.Stamp(int(f["birth"][0]),
+                                      float(f["birth"][1]),
+                                      float(f["birth"][2]))
+                         if f["birth"] else None)
+                queued = (rt_lat.Stamp(int(f["queued"][0]),
+                                       float(f["queued"][1]),
+                                       float(f["queued"][2]))
+                          if f["queued"] else None)
+                frame = _Frame(int(f["seq"]), int(f["kind"]),
+                               int(f["epoch"]),
+                               base64.b64decode(f["wire"]),
+                               int(f["crc"]), int(f["row_offset"]),
+                               int(f["nrows"]), int(f["task"]),
+                               codec=int(f["codec"]),
+                               payload_bytes=int(f["payload_bytes"]),
+                               data_crc=int(f["data_crc"]),
+                               birth=birth, queued=queued)
+                state.replay.append(frame)
+                state.replay_bytes += frame.size
+                frame.tenant = self._charge_tenant(q, frame.size)
+            with self._states_lock:
+                self._states[q] = state
+            if self._journal is not None:
+                for seq, stamp in births.items():
+                    self._journal.record_birth(q, seq, stamp.pid,
+                                               stamp.t_mono, stamp.t_unix)
+                for frame in state.replay:
+                    if frame.birth is not None:
+                        self._journal.record_birth(
+                            q, frame.seq, frame.birth.pid,
+                            frame.birth.t_mono, frame.birth.t_unix)
+                if state.acked_seq >= 0:
+                    self._journal.record(q, state.acked_seq,
+                                         state.acked_rows,
+                                         done=state.done)
+        self._rank_gen[rank] = generation
+        self._extra_ranks.add(rank)
+        self._moved.pop(rank, None)
+        self._sealed_ranks.discard(rank)
+        logger.warning("shard %d adopted rank %d at placement generation "
+                       "%d (%d queue(s))", self._shard_index, rank,
+                       generation, len(manifest["queues"]))
+
+    def _release_rank(self, rank: int, generation: int,
+                      target: Tuple[str, int]) -> None:
+        """Post-COMMIT: drop the source's copy of a migrated rank and
+        start answering its GETs with ``KIND_MOVED`` redirects. The
+        shared ``MultiQueue`` is deliberately NOT drained — in the
+        in-process topology the adopting server pops the same queue
+        objects, so undelivered items flow to the target untouched."""
+        for q in self._rank_queues(rank):
+            state = self._state(q)
+            with state.lock:
+                while state.replay:
+                    frame = state.replay.popleft()
+                    state.replay_bytes -= frame.size
+                    self._charge_tenant(q, -frame.size, frame.tenant)
+                    self._release_frame(frame)
+            with self._states_lock:
+                self._states.pop(q, None)
+        self._sealed_ranks.discard(rank)
+        self._extra_ranks.discard(rank)
+        self._moved[rank] = (generation,
+                             (str(target[0]), int(target[1])))
+        logger.warning("shard %d released rank %d to %s:%d at placement "
+                       "generation %d", self._shard_index, rank,
+                       target[0], target[1], generation)
 
     # -- consumer leases ----------------------------------------------------
 
@@ -1585,13 +1952,78 @@ def serve_queue(queue: mq.MultiQueue,
                 exit_on_crash_site: bool = False,
                 shard_index: int = 0, num_shards: int = 1,
                 handle_dir: Optional[str] = None,
-                tenants: Optional[dict] = None) -> QueueServer:
+                tenants: Optional[dict] = None,
+                placement: Optional[dict] = None) -> QueueServer:
     """Start serving ``queue`` on ``address`` (port 0 = ephemeral)."""
     return QueueServer(queue, address, num_trainers=num_trainers,
                        journal=journal, initial_state=initial_state,
                        exit_on_crash_site=exit_on_crash_site,
                        shard_index=shard_index, num_shards=num_shards,
-                       handle_dir=handle_dir, tenants=tenants)
+                       handle_dir=handle_dir, tenants=tenants,
+                       placement=placement)
+
+
+def _rebalance_call(address: Tuple[str, int], phase: int, rank: int,
+                    generation: int, payload: bytes = b"",
+                    timeout_s: float = 30.0) -> str:
+    """One OP_REBALANCE round trip on a short-lived admin connection.
+    Returns the raw ``checkpoint.crc_line`` response (CRC verified;
+    ``{"error": ...}`` entries raise)."""
+    from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+    with socket.create_connection(tuple(address),
+                                  timeout=timeout_s) as sock:
+        sock.sendall(_REQUEST.pack(OP_REBALANCE, phase, rank, generation,
+                                   len(payload)) + payload)
+        (length,) = _BATCH_HEADER.unpack(
+            _recv_exact(sock, _BATCH_HEADER.size))
+        line = _recv_exact(sock, length).decode("utf-8")
+    entry = ckpt.parse_crc_line(line)
+    if "error" in entry:
+        raise RuntimeError(
+            f"rebalance phase {phase} for rank {rank} failed on "
+            f"{address[0]}:{address[1]}: {entry['error']}")
+    return line
+
+
+def rebalance_prepare(address: Tuple[str, int], rank: int,
+                      generation: int, timeout_s: float = 30.0) -> str:
+    """PREPARE on the source shard: seal ``rank`` at its watermark and
+    return its CRC'd handoff manifest line — ship this string VERBATIM
+    to :func:`rebalance_adopt` so the target re-verifies the same CRC
+    the source computed."""
+    return _rebalance_call(address, REB_PREPARE, rank, generation,
+                           timeout_s=timeout_s)
+
+
+def rebalance_adopt(address: Tuple[str, int], manifest_line: str,
+                    timeout_s: float = 30.0) -> str:
+    """COMMIT on the target shard: install the manifest's queue states
+    and merge its watermarks into the target's journal."""
+    from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+    manifest = ckpt.parse_crc_line(manifest_line)["manifest"]
+    return _rebalance_call(address, REB_ADOPT, int(manifest["rank"]),
+                           int(manifest["generation"]),
+                           payload=manifest_line.encode("utf-8"),
+                           timeout_s=timeout_s)
+
+
+def rebalance_release(address: Tuple[str, int], rank: int,
+                      generation: int, target: Tuple[str, int],
+                      timeout_s: float = 30.0) -> str:
+    """Post-COMMIT on the source shard: drop the migrated rank's state
+    and start redirecting its consumers to ``target``."""
+    payload = json.dumps({"host": str(target[0]),
+                          "port": int(target[1])}).encode("utf-8")
+    return _rebalance_call(address, REB_RELEASE, rank, generation,
+                           payload=payload, timeout_s=timeout_s)
+
+
+def rebalance_unseal(address: Tuple[str, int], rank: int,
+                     timeout_s: float = 30.0) -> str:
+    """ABORT cleanup on the source shard: lift a PREPARE seal so the
+    still-authoritative source resumes serving new frames."""
+    return _rebalance_call(address, REB_UNSEAL, rank, 0,
+                           timeout_s=timeout_s)
 
 
 class ShardedQueueServer:
@@ -1783,6 +2215,16 @@ class RemoteQueue:
         self._corrupt = rt_metrics.counter(
             "rsdl_queue_frames_corrupt_total",
             "frames rejected client-side on CRC mismatch")
+        #: rank -> placement-generation fence floor (rebalance/). Raised
+        #: by a KIND_MOVED redirect or adopt_positions(); any data frame
+        #: stamped BELOW the floor is a zombie source still serving a
+        #: migrated rank — dropped loudly, never delivered. Plain int
+        #: reads/writes under the GIL; 0 (the pre-rebalance stamp) means
+        #: no fence and reproduces the v3.2 wire behavior exactly.
+        self._gen_floor: Dict[int, int] = {}
+        self._fenced = rt_metrics.counter(
+            "rsdl_rebalance_fenced_frames_total",
+            "frames dropped below the placement-generation fence")
         try:
             self._retry.call(self._reconnect, describe=f"connect {address}")
         except OSError as e:
@@ -1866,6 +2308,36 @@ class RemoteQueue:
                 self._committed[q] = max(self._committed[q],
                                          self._delivered[q])
 
+    def export_positions(self, rank: int) -> Dict[int, Tuple[int, int]]:
+        """Snapshot ``{queue: (delivered, committed)}`` for every queue
+        of ``rank`` this client has touched, dropping its local buffers
+        (a post-migration replay from the adopting shard supersedes
+        them). The router hands this to the new shard's client via
+        :meth:`adopt_positions` so the handoff stays exactly-once."""
+        positions: Dict[int, Tuple[int, int]] = {}
+        with self._state_lock:
+            for q in set(self._delivered) | set(self._committed):
+                if plan_ir.queue_rank(q, self._num_trainers) != rank:
+                    continue
+                positions[q] = (self._delivered[q], self._committed[q])
+                self._buffers.pop(q, None)
+                self._pending.pop(q, None)
+        return positions
+
+    def adopt_positions(self, positions: Dict[int, Tuple[int, int]],
+                        generation: int = 0,
+                        rank: Optional[int] = None) -> None:
+        """Merge another client's delivered/committed watermarks (max
+        wins — positions only ever advance) and raise ``rank``'s fence
+        floor to ``generation``, so this client's first GET resumes at
+        the exact frame the old shard's stream stopped at."""
+        with self._state_lock:
+            for q, (delivered, committed) in positions.items():
+                self._delivered[q] = max(self._delivered[q], delivered)
+                self._committed[q] = max(self._committed[q], committed)
+            if rank is not None and generation > self._gen_floor.get(rank, 0):
+                self._gen_floor[rank] = generation
+
     def _fetch_batch(self, queue_index: int) -> Tuple[List, bool]:
         """One wire round trip: request up to ``max_batch`` items and
         decode + CRC-verify the response frames. Runs on the caller's
@@ -1904,10 +2376,12 @@ class RemoteQueue:
                     frames = []
                     corrupt_seq = None
                     handle_fail_seq = None
+                    rank = plan_ir.queue_rank(queue_index,
+                                              self._num_trainers)
                     for _ in range(count):
                         (kind_byte, epoch, seq, crc, row_offset, length,
                          src_task, b_mono, b_unix, b_pid, q_mono, q_unix,
-                         q_pid) = _FRAME.unpack(
+                         q_pid, gen) = _FRAME.unpack(
                              _recv_exact(self._sock, _FRAME.size))
                         kind = kind_byte & _KIND_MASK
                         codec = kind_byte >> 4
@@ -1919,6 +2393,44 @@ class RemoteQueue:
                         if corrupt_seq is not None \
                                 or handle_fail_seq is not None:
                             continue  # drain framing past the bad frame
+                        if kind == KIND_MOVED:
+                            # Live-migration redirect (rebalance/): raise
+                            # this rank's fence floor FIRST (so a zombie
+                            # source can never out-race the redirect),
+                            # then surface the new address to the router.
+                            blob = bytes(payload)
+                            if _crc(blob) != crc:
+                                raise ConnectionError(
+                                    "MOVED redirect failed CRC; refetching")
+                            info = json.loads(blob.decode())
+                            moved_gen = int(info["generation"])
+                            if moved_gen > self._gen_floor.get(rank, 0):
+                                self._gen_floor[rank] = moved_gen
+                            raise QueueMoved(queue_index,
+                                             int(info["rank"]),
+                                             (info["host"], info["port"]),
+                                             moved_gen)
+                        if kind != KIND_FAILURE:
+                            # Placement-generation fence: a data frame
+                            # stamped below this rank's floor comes from
+                            # a zombie source still serving a migrated
+                            # rank — drop it loudly. Failure frames are
+                            # exempt (stamped 0): errors always land.
+                            floor = self._gen_floor.get(rank, 0)
+                            if gen < floor:
+                                self._fenced.inc()
+                                rt_telemetry.record(
+                                    "rebalance_fence", epoch=epoch,
+                                    task=queue_index, seq=seq,
+                                    generation=gen, floor=floor)
+                                logger.warning(
+                                    "queue %d: fenced frame %d from "
+                                    "zombie source (generation %d < "
+                                    "floor %d)", queue_index, seq, gen,
+                                    floor)
+                                continue
+                            if gen > floor:
+                                self._gen_floor[rank] = gen
                         try:
                             # CRC is pre-compression: decompress first,
                             # verify the logical bytes (a torn
@@ -2232,13 +2744,62 @@ class ShardedRemoteQueue:
     def client_for_queue(self, queue_index: int) -> RemoteQueue:
         return self._client(self._shard_map.shard_for_queue(queue_index))
 
+    def _apply_move(self, moved: QueueMoved) -> None:
+        """Follow a live-migration redirect: rewrite the local shard
+        map's override for the moved rank, transfer the old shard
+        client's delivered/committed positions to the new shard's client
+        (max-merge — exactly-once across the handoff), and raise its
+        fence floor so the zombie source's stragglers are dropped."""
+        target_shard = None
+        for shard, addr in enumerate(self._shard_map.addresses):
+            if (str(addr[0]), int(addr[1])) == moved.address:
+                target_shard = shard
+                break
+        if target_shard is None:
+            raise RuntimeError(
+                f"MOVED redirect names {moved.address[0]}:"
+                f"{moved.address[1]}, which is not in this consumer's "
+                f"shard map — the placement decision and the map "
+                f"disagree") from moved
+        with self._clients_lock:
+            old_shard = self._shard_map.shard_for_rank(moved.rank)
+            self._shard_map.overrides[moved.rank] = target_shard
+            self._shard_map.generation = max(self._shard_map.generation,
+                                             moved.generation)
+            old_client = self._clients.get(old_shard)
+        positions = (old_client.export_positions(moved.rank)
+                     if old_client is not None else {})
+        self._client(target_shard).adopt_positions(
+            positions, generation=moved.generation, rank=moved.rank)
+        logger.warning(
+            "following MOVED redirect: rank %d shard %d -> %d at "
+            "placement generation %d (%d queue position(s) carried)",
+            moved.rank, old_shard, target_shard, moved.generation,
+            len(positions))
+
+    def _route(self, queue_index: int, op: Callable):
+        """Run one consumer op against the owning shard, transparently
+        following up to a handful of MOVED redirects (a stable placement
+        needs exactly one; a bound stops a routing loop from a
+        misconfigured plane)."""
+        for _ in range(4):
+            try:
+                return op(self.client_for_queue(queue_index))
+            except QueueMoved as moved:
+                self._apply_move(moved)
+        raise RuntimeError(
+            f"queue {queue_index} still redirecting after 4 MOVED "
+            f"hops; placement plane is unstable or misconfigured")
+
     def get_positioned(self, queue_index: int):
-        return self.client_for_queue(queue_index).get_positioned(
-            queue_index)
+        return self._route(
+            queue_index,
+            lambda client: client.get_positioned(queue_index))
 
     def get(self, queue_index: int, block: bool = True):
-        return self.client_for_queue(queue_index).get(queue_index,
-                                                      block=block)
+        return self._route(
+            queue_index,
+            lambda client: client.get(queue_index, block=block))
 
     def commit(self, queue_index: Optional[int] = None) -> None:
         if queue_index is not None:
@@ -2363,9 +2924,17 @@ def serve_pipeline(config: dict):
     num_trainers = int(config["num_trainers"])
     num_shards = int(config.get("num_shards", 1))
     shard_index = int(config.get("shard_index", 0))
-    owned_ranks = (plan_ir.shard_ranks(shard_index, num_trainers,
-                                       num_shards)
-                   if num_shards > 1 else None)
+    # Placement overrides (rebalance/): a restarted incarnation launched
+    # AFTER a committed migration owns the post-move rank set — the
+    # journal merge the adoption performed makes the resume exact.
+    placement = config.get("placement") or {}
+    overrides = {int(r): int(s)
+                 for r, s in dict(placement.get("overrides", {})).items()}
+    if num_shards > 1:
+        owned_ranks = [r for r in range(num_trainers)
+                       if overrides.get(r, r % num_shards) == shard_index]
+    else:
+        owned_ranks = None
     journal_path = config["journal_path"]
     handle_dir = config.get("handle_dir")
     if not handle_dir:
@@ -2445,7 +3014,8 @@ def serve_pipeline(config: dict):
         num_trainers=num_trainers, journal=journal, initial_state=state,
         exit_on_crash_site=True, shard_index=shard_index,
         num_shards=num_shards, handle_dir=handle_dir,
-        tenants=config.get("tenants"))
+        tenants=config.get("tenants"),
+        placement=config.get("placement"))
     rt_metrics.gauge(
         "rsdl_queue_serve_shards",
         "shard count of the live queue serving plane").set(num_shards)

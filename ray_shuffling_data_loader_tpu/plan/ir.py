@@ -576,11 +576,20 @@ class ShardMap:
     port)``. Stdlib-only and JSON round-trippable (stable key order)
     like :class:`EpochPlan`, so tools and child-process configs can
     carry it verbatim.
+
+    ``overrides`` (rank -> shard) layers the rebalancer's live moves on
+    top of the static ``rank % num_shards`` arithmetic, and
+    ``generation`` counts committed placement changes — it is the fence
+    stamped into every wire frame so a zombie source shard's post-move
+    frames are loudly droppable. Both serialize only when non-default,
+    so pre-rebalance maps round-trip byte-identically.
     """
 
     num_trainers: int
     addresses: List[Tuple[str, int]]
     version: int = SHARD_MAP_VERSION
+    overrides: Dict[int, int] = dataclasses.field(default_factory=dict)
+    generation: int = 0
 
     @property
     def num_shards(self) -> int:
@@ -597,26 +606,42 @@ class ShardMap:
         for addr in self.addresses:
             if len(tuple(addr)) != 2 or not isinstance(addr[0], str):
                 raise PlanError(f"malformed shard address {addr!r}")
+        if self.generation < 0:
+            raise PlanError("shard map generation must be >= 0")
+        for rank, shard in self.overrides.items():
+            if not 0 <= int(rank) < self.num_trainers:
+                raise PlanError(f"override for unknown rank {rank}")
+            if not 0 <= int(shard) < self.num_shards:
+                raise PlanError(
+                    f"override routes rank {rank} to unknown shard {shard}")
 
     def shard_for_queue(self, queue_idx: int) -> int:
-        return queue_shard(queue_idx, self.num_trainers, self.num_shards)
+        return self.shard_for_rank(
+            queue_rank(queue_idx, self.num_trainers))
 
     def shard_for_rank(self, rank: int) -> int:
-        return rank % self.num_shards
+        return self.overrides.get(rank, rank % self.num_shards)
 
     def ranks_for_shard(self, shard: int) -> List[int]:
-        return shard_ranks(shard, self.num_trainers, self.num_shards)
+        return [rank for rank in range(self.num_trainers)
+                if self.shard_for_rank(rank) == shard]
 
     def address_for_queue(self, queue_idx: int) -> Tuple[str, int]:
         return tuple(self.addresses[self.shard_for_queue(queue_idx)])
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        data: Dict[str, Any] = {
             "version": self.version,
             "num_trainers": self.num_trainers,
             "addresses": [[host, int(port)]
                           for host, port in self.addresses],
         }
+        if self.overrides:
+            data["overrides"] = {str(rank): int(shard) for rank, shard
+                                 in sorted(self.overrides.items())}
+        if self.generation:
+            data["generation"] = self.generation
+        return data
 
     def to_json(self, indent: Optional[int] = None) -> str:
         return json.dumps(self.to_dict(), indent=indent, sort_keys=False)
@@ -627,7 +652,10 @@ class ShardMap:
             shard_map = cls(
                 num_trainers=int(data["num_trainers"]),
                 addresses=[(str(h), int(p)) for h, p in data["addresses"]],
-                version=int(data.get("version", SHARD_MAP_VERSION)))
+                version=int(data.get("version", SHARD_MAP_VERSION)),
+                overrides={int(rank): int(shard) for rank, shard
+                           in dict(data.get("overrides", {})).items()},
+                generation=int(data.get("generation", 0)))
         except (KeyError, TypeError, ValueError) as e:
             raise PlanError(f"malformed shard map: {e}") from e
         shard_map.validate()

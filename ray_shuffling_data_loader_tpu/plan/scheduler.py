@@ -535,3 +535,50 @@ def rewrite_for_view(plan: ir.EpochPlan,
                        "onto live ranks %s", plan.epoch, moved,
                        sorted(int(r) for r in live_ranks))
     return moved
+
+
+def rebalance_queues(shard_map: ir.ShardMap,
+                     moves: Dict[int, int]) -> ir.ShardMap:
+    """Rebalance-as-plan-rewrite: re-home trainer ranks' queues onto
+    other shards of the serving fabric.
+
+    The ``rewrite_for_view`` sibling for the serving plane: ``moves``
+    maps trainer rank -> target shard, and the result is a NEW
+    :class:`plan.ir.ShardMap` whose ``overrides`` carry the merged
+    placement and whose ``generation`` is bumped by one — the fence the
+    wire protocol stamps into every frame so post-move frames from the
+    old home are droppable. Pure data-in/data-out (the input map is
+    never mutated); no-op moves (rank already on the target) are
+    dropped, and if every move is a no-op the INPUT map is returned
+    unchanged so callers can cheaply detect "nothing to do" by
+    identity. Raises :class:`plan.ir.PlanError` on out-of-range ranks
+    or shards (``ShardMap.validate``).
+    """
+    overrides = dict(shard_map.overrides)
+    applied: Dict[int, int] = {}
+    for rank, shard in sorted(moves.items()):
+        rank, shard = int(rank), int(shard)
+        if shard_map.shard_for_rank(rank) == shard:
+            continue
+        overrides[rank] = shard
+        applied[rank] = shard
+    if not applied:
+        return shard_map
+    # An override that lands a rank back on its static home is pure
+    # noise — drop it so maps stay canonical (and serialize minimally).
+    overrides = {rank: shard for rank, shard in overrides.items()
+                 if shard != rank % shard_map.num_shards}
+    rebalanced = ir.ShardMap(
+        num_trainers=shard_map.num_trainers,
+        addresses=[tuple(addr) for addr in shard_map.addresses],
+        version=shard_map.version,
+        overrides=overrides,
+        generation=shard_map.generation + 1)
+    rebalanced.validate()
+    rt_telemetry.record("plan_rebalance",
+                        generation=rebalanced.generation,
+                        moves={str(r): s for r, s in applied.items()})
+    logger.warning("shard map generation %d: rebalanced %d rank(s) %s",
+                   rebalanced.generation, len(applied),
+                   {r: s for r, s in applied.items()})
+    return rebalanced

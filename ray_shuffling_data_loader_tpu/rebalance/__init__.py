@@ -1,0 +1,659 @@
+"""Self-healing serving plane: journaled, SLO-driven live queue
+rebalancing with crash-safe exactly-once handoff.
+
+PR 10 sharded the queue fabric and PR 17 made tenants share a shard
+fairly, but placement stayed **frozen at launch**: when one tenant or
+rank runs hot its shard saturates while siblings idle, and nothing
+closed the detector->actuator loop. This package is that loop's brain —
+the *decision* plane. The *actuator* (the two-phase PREPARE/ADOPT/
+RELEASE wire protocol that actually moves a live queue between shard
+processes) lives in ``multiqueue_service.py``; :func:`migrate` drives
+it end to end.
+
+The discipline is the membership/tenancy recipe applied to placement:
+
+- :class:`PlacementDecision` — one journaled decision (``intent``,
+  ``commit``, ``abort``; ``bootstrap``/``snapshot`` are journal bases).
+- :class:`PlacementState` — the immutable fold target: committed
+  ``overrides`` (rank -> shard), the placement ``generation`` (the
+  fence stamped into every wire frame), and at most ONE ``pending``
+  in-flight move.
+- :func:`apply_decision` — THE pure transition function. No wall
+  clock, no dict-order dependence: a journal is a fold of decisions
+  over the bootstrap state, so :func:`replay` re-derives every decision
+  byte-identically and raises on any divergence.
+- :class:`RebalanceJournal` — the crc'd append-only JSONL discipline of
+  ``checkpoint.WatermarkJournal`` (torn tails skipped, interior
+  corruption raises, atomic compact) applied to placement decisions.
+- :class:`RebalanceController` — the runtime hub: owns the state,
+  journals decisions, enforces the RSDL_REBALANCE_* policy knobs
+  (SLO threshold, cooldown, max moves per window), and — the
+  crash-safety keystone — **journals an abort for any trailing
+  uncommitted intent at restart**, so a driver killed mid-decision
+  always recovers to "source authoritative".
+
+Crash matrix (the headline): kill -9 of the source shard mid-PREPARE
+or the target shard mid-COMMIT leaves the commit unjournaled, so the
+source stays authoritative and the supervised restart resumes it from
+its watermark journal; a driver killed mid-decision aborts on restart.
+In every case the merged delivered stream is bit-identical — zero
+missed or duplicated ``row_offset``s — because adoption replays the
+source's unacked frames from a CRC'd manifest and the client's seq
+dedup drops anything already delivered. A zombie source that wakes up
+after the move stamps its frames with the stale generation and the
+client fences them loudly (``rsdl_rebalance_fenced_frames_total``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ray_shuffling_data_loader_tpu import checkpoint as ckpt
+from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+from ray_shuffling_data_loader_tpu.runtime import metrics as rt_metrics
+from ray_shuffling_data_loader_tpu.runtime import policy as rt_policy
+from ray_shuffling_data_loader_tpu.runtime import telemetry as rt_telemetry
+from ray_shuffling_data_loader_tpu.utils.logger import setup_custom_logger
+
+logger = setup_custom_logger(__name__)
+
+#: Journaled decision kinds. ``bootstrap``/``snapshot`` carry a whole
+#: state (journal base lines); the rest are the deltas folded over it.
+DECISION_KINDS = ("bootstrap", "snapshot", "intent", "commit", "abort")
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementDecision:
+    """One placement transition. ``rank``/``source``/``target`` are
+    meaningful for ``intent``/``commit``/``abort``; base records use
+    rank -1. ``reason`` is free text for humans and telemetry (inside
+    the crc'd line, so it replays byte-identically too)."""
+
+    kind: str
+    rank: int = -1
+    source: int = -1
+    target: int = -1
+    reason: str = ""
+
+    def to_dict(self) -> dict:
+        return {"kind": self.kind, "rank": self.rank,
+                "source": self.source, "target": self.target,
+                "reason": self.reason}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "PlacementDecision":
+        return cls(kind=data["kind"], rank=int(data["rank"]),
+                   source=int(data["source"]), target=int(data["target"]),
+                   reason=data.get("reason", ""))
+
+
+@dataclasses.dataclass(frozen=True)
+class PlacementState:
+    """One immutable placement: the committed rank->shard ``overrides``
+    layered over the static ``rank % num_shards`` arithmetic, the
+    placement ``generation`` (bumped once per COMMIT — the wire fence),
+    and at most one ``pending`` in-flight move ``(rank, source,
+    target)`` between its intent and its commit/abort."""
+
+    num_trainers: int
+    num_shards: int
+    generation: int
+    overrides: Tuple[Tuple[int, int], ...]  # sorted (rank, shard)
+    pending: Optional[Tuple[int, int, int]] = None
+
+    def shard_for_rank(self, rank: int) -> int:
+        for r, shard in self.overrides:
+            if r == rank:
+                return shard
+        return rank % self.num_shards
+
+    def to_dict(self) -> dict:
+        return {"num_trainers": self.num_trainers,
+                "num_shards": self.num_shards,
+                "generation": self.generation,
+                "overrides": [[r, s] for r, s in self.overrides],
+                "pending": list(self.pending) if self.pending else None}
+
+    @classmethod
+    def from_dict(cls, data: dict) -> "PlacementState":
+        pending = data.get("pending")
+        return cls(num_trainers=int(data["num_trainers"]),
+                   num_shards=int(data["num_shards"]),
+                   generation=int(data["generation"]),
+                   overrides=tuple((int(r), int(s))
+                                   for r, s in data["overrides"]),
+                   pending=tuple(int(v) for v in pending)
+                   if pending else None)
+
+    @classmethod
+    def bootstrap(cls, shard_map: plan_ir.ShardMap) -> "PlacementState":
+        return cls(num_trainers=shard_map.num_trainers,
+                   num_shards=shard_map.num_shards,
+                   generation=shard_map.generation,
+                   overrides=tuple(sorted(
+                       (int(r), int(s))
+                       for r, s in shard_map.overrides.items())))
+
+
+def apply_decision(state: PlacementState,
+                   decision: PlacementDecision) -> PlacementState:
+    """THE pure placement-transition function: ``(state, decision) ->
+    state``. No wall clock, no randomness, no dict-order dependence — a
+    journal is a fold of its decisions over the bootstrap state, and
+    :func:`replay` re-runs the fold to prove the journal.
+
+    An ``intent`` whose target is already the rank's home is a no-op
+    (returns ``state`` unchanged — the controller never journals it);
+    an intent over a pending move, or a commit/abort that does not
+    match the pending move, is a protocol violation and raises — the
+    two-phase discipline allows exactly one move in flight."""
+    if decision.kind not in DECISION_KINDS:
+        raise ValueError(
+            f"unknown placement decision kind {decision.kind!r}")
+    if decision.kind in ("bootstrap", "snapshot"):
+        raise ValueError(
+            f"{decision.kind} records carry their own state; "
+            "apply_decision folds only intent/commit/abort deltas")
+    if decision.kind == "intent":
+        if state.pending is not None:
+            raise ValueError(
+                f"intent for rank {decision.rank} while move "
+                f"{state.pending} is pending (one move in flight)")
+        if not 0 <= decision.rank < state.num_trainers:
+            raise ValueError(f"intent for unknown rank {decision.rank}")
+        if not 0 <= decision.target < state.num_shards:
+            raise ValueError(
+                f"intent routes rank {decision.rank} to unknown shard "
+                f"{decision.target}")
+        source = state.shard_for_rank(decision.rank)
+        if decision.source != source:
+            raise ValueError(
+                f"intent names source {decision.source} but rank "
+                f"{decision.rank} lives on shard {source}")
+        if decision.target == source:
+            return state  # no-op: never journaled, never replayed
+        return dataclasses.replace(
+            state, pending=(decision.rank, source, decision.target))
+    # commit/abort: must resolve THE pending move.
+    move = (decision.rank, decision.source, decision.target)
+    if state.pending != move:
+        raise ValueError(
+            f"{decision.kind} for move {move} but pending is "
+            f"{state.pending}")
+    if decision.kind == "abort":
+        return dataclasses.replace(state, pending=None)
+    overrides = {r: s for r, s in state.overrides}
+    if decision.target == decision.rank % state.num_shards:
+        overrides.pop(decision.rank, None)  # back on its static home
+    else:
+        overrides[decision.rank] = decision.target
+    return dataclasses.replace(
+        state, generation=state.generation + 1,
+        overrides=tuple(sorted(overrides.items())), pending=None)
+
+
+class RebalanceJournal:
+    """Crc'd append-only journal of placement decisions.
+
+    Each line is ``{"decision": ..., "placement": ...}`` in the shared
+    :func:`checkpoint.crc_line` discipline: the recorded placement is
+    the RESULT of folding the decision over the previous line's state,
+    which is what makes the file self-verifying — :func:`replay` re-runs
+    the fold and any divergence (tamper, version skew, an unjournaled
+    transition) raises. The first line is always a base record
+    (``bootstrap``, or ``snapshot`` after :meth:`compact`).
+
+    ``path=None`` keeps the journal in memory; with a path every line
+    is flushed + fsync'd BEFORE the decision takes effect anywhere, so
+    a crashed driver restarts into the exact decision history it last
+    advertised — the abort-trailing-intent recovery hangs off this.
+    """
+
+    def __init__(self, path: Optional[str] = None):
+        self._path = path
+        self._lock = threading.Lock()
+        self._file = None
+        self._lines: List[str] = []
+
+    @property
+    def path(self) -> Optional[str]:
+        return self._path
+
+    @staticmethod
+    def encode(decision: PlacementDecision, state: PlacementState) -> str:
+        return ckpt.crc_line({"decision": decision.to_dict(),
+                              "placement": state.to_dict()})
+
+    def record(self, decision: PlacementDecision,
+               state: PlacementState) -> None:
+        line = self.encode(decision, state)
+        with self._lock:
+            self._lines.append(line)
+            if self._path is not None:
+                if self._file is None:
+                    directory = os.path.dirname(os.path.abspath(self._path))
+                    os.makedirs(directory, exist_ok=True)
+                    self._file = open(self._path, "a", encoding="utf-8")
+                self._file.write(line + "\n")
+                self._file.flush()
+                os.fsync(self._file.fileno())
+
+    def journal_bytes(self) -> bytes:
+        """The journal as emitted (the replay-comparison target)."""
+        with self._lock:
+            return "".join(line + "\n" for line in self._lines).encode()
+
+    @classmethod
+    def load(cls, path: str) -> List[dict]:
+        """Every intact ``{"decision", "placement"}`` record in append
+        order; a torn TAIL line (crash mid-write) is skipped with a
+        warning, but an unreadable line with intact lines after it is
+        corruption and raises — an interior gap would silently rewrite
+        history."""
+        records: List[dict] = []
+        bad: Optional[Tuple[int, str]] = None
+        if not os.path.exists(path):
+            return records
+        with open(path, encoding="utf-8") as f:
+            for lineno, line in enumerate(f, 1):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    entry = ckpt.parse_crc_line(line)
+                    record = {"decision": PlacementDecision.from_dict(
+                                  entry["decision"]),
+                              "placement": PlacementState.from_dict(
+                                  entry["placement"]),
+                              "line": line}
+                except (ValueError, KeyError, TypeError) as e:
+                    if bad is not None:
+                        raise ValueError(
+                            f"rebalance journal {path}: multiple "
+                            f"unreadable lines ({bad[0]}: {bad[1]}; "
+                            f"{lineno}: {e}) — corruption, not a torn "
+                            "tail")
+                    bad = (lineno, str(e))
+                    continue
+                if bad is not None:
+                    raise ValueError(
+                        f"rebalance journal {path}: line {bad[0]} "
+                        f"unreadable ({bad[1]}) but line {lineno} is "
+                        "intact — interior corruption, not a torn tail")
+                records.append(record)
+        if bad is not None:
+            logger.warning(
+                "rebalance journal %s line %d unreadable (%s); skipping "
+                "(torn tail from a crash is expected)", path, bad[0],
+                bad[1])
+        return records
+
+    def compact(self) -> None:
+        """Rewrite the journal as ONE snapshot record of the latest
+        state — atomic tmp + fsync + rename (the WatermarkJournal
+        discipline), so the append-only file cannot grow unboundedly
+        across a long-lived serving plane's churn."""
+        assert self._path is not None, "in-memory journals need no compact"
+        records = self.load(self._path)
+        if not records:
+            return
+        state = records[-1]["placement"]
+        line = self.encode(PlacementDecision(kind="snapshot",
+                                             reason="compact"), state)
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+            directory = os.path.dirname(os.path.abspath(self._path))
+            os.makedirs(directory, exist_ok=True)
+            fd, tmp_path = tempfile.mkstemp(dir=directory, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.write(line + "\n")
+                    f.flush()
+                    os.fsync(f.fileno())
+                os.replace(tmp_path, self._path)
+                dir_fd = os.open(directory, os.O_RDONLY)
+                try:
+                    os.fsync(dir_fd)
+                finally:
+                    os.close(dir_fd)
+            except BaseException:
+                if os.path.exists(tmp_path):
+                    os.remove(tmp_path)
+                raise
+            self._lines = [line]
+
+    def close(self) -> None:
+        with self._lock:
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+
+
+def replay(path: str) -> PlacementState:
+    """Rebuild the latest placement from a journal and PROVE the
+    rebuild: every ``intent``/``commit``/``abort`` record's state must
+    equal ``apply_decision(previous_state, decision)`` — re-encoded
+    byte-identically against the journaled line — and the journal must
+    begin with a base record. Any divergence raises ``ValueError``
+    (tamper, corruption, or version skew in the transition function).
+    Returns the verified latest state."""
+    records = RebalanceJournal.load(path)
+    if not records:
+        raise ValueError(f"rebalance journal {path} has no records")
+    first = records[0]
+    if first["decision"].kind not in ("bootstrap", "snapshot"):
+        raise ValueError(
+            f"rebalance journal {path} does not begin with a "
+            f"bootstrap/snapshot record (got {first['decision'].kind!r})")
+    state = first["placement"]
+    for index, record in enumerate(records[1:], 2):
+        decision = record["decision"]
+        if decision.kind in ("bootstrap", "snapshot"):
+            raise ValueError(
+                f"rebalance journal {path} record {index}: base record "
+                "after the journal head (history rewrite)")
+        derived = apply_decision(state, decision)
+        rederived = RebalanceJournal.encode(decision, derived)
+        if rederived != record["line"]:
+            raise ValueError(
+                f"rebalance journal {path} record {index} diverged on "
+                f"replay: decision {decision.to_dict()} over generation "
+                f"{state.generation} re-derives {derived.to_dict()}, "
+                "journal disagrees (tamper, corruption, or transition "
+                "version skew)")
+        if derived == state:
+            raise ValueError(
+                f"rebalance journal {path} record {index}: journaled "
+                f"no-op decision {decision.to_dict()} (the controller "
+                "never journals unchanged placements)")
+        state = derived
+    return state
+
+
+class RebalanceController:
+    """The placement decision hub: current state + journal + policy.
+
+    Decisions come from the ``tenant_delivery_slo`` health detector (a
+    sustained per-tenant delivery-p99 breach names a hot rank), from
+    chaos, or from an operator. Each one folds through
+    :func:`apply_decision`, is journaled BEFORE any actuator byte
+    moves, and emits the ``rebalance_*`` telemetry/metric vocabulary.
+
+    Crash recovery is the whole point of the journal: a controller
+    constructed over an existing journal replays it (proving every
+    byte) and, if the tail is an uncommitted ``intent``, journals the
+    matching ``abort`` — the driver died mid-decision, no COMMIT was
+    journaled, so the source shard is authoritative and the move never
+    happened. The RSDL_REBALANCE_* knobs gate :meth:`may_move`:
+    ``rebalance_cooldown_s`` is the sliding window and
+    ``rebalance_max_moves`` the commit budget inside it (so one hot
+    tenant cannot ping-pong between shards while its post-move p99
+    window drains).
+    """
+
+    def __init__(self, shard_map: plan_ir.ShardMap,
+                 journal_path: Optional[str] = None,
+                 component: str = "rebalance", **overrides: Any):
+        resolve = lambda key: rt_policy.resolve(  # noqa: E731
+            component, key, override=overrides.get(key))
+        self.slo_p99_s = float(resolve("rebalance_slo_p99_s"))
+        self.cooldown_s = float(resolve("rebalance_cooldown_s"))
+        self.max_moves = int(resolve("rebalance_max_moves"))
+        self._lock = threading.Lock()
+        self._base_map = shard_map
+        self._journal = RebalanceJournal(journal_path)
+        self._commit_times: List[float] = []
+        self.moves_total = 0
+        recovered = journal_path is not None and os.path.exists(
+            journal_path) and os.path.getsize(journal_path) > 0
+        if recovered:
+            self._state = replay(journal_path)
+        else:
+            self._state = PlacementState.bootstrap(shard_map)
+            self._journal.record(PlacementDecision(
+                kind="bootstrap", reason="initial placement"), self._state)
+        self._export(self._state)
+        if recovered and self._state.pending is not None:
+            rank, source, target = self._state.pending
+            self.abort(rank, reason="controller restart with uncommitted "
+                                    "intent: source authoritative")
+
+    # -- state ---------------------------------------------------------
+
+    def current_state(self) -> PlacementState:
+        with self._lock:
+            return self._state
+
+    def current_map(self) -> plan_ir.ShardMap:
+        """The live :class:`plan.ir.ShardMap`: the base addresses with
+        this controller's committed overrides and generation applied."""
+        state = self.current_state()
+        shard_map = plan_ir.ShardMap(
+            num_trainers=self._base_map.num_trainers,
+            addresses=[tuple(a) for a in self._base_map.addresses],
+            version=self._base_map.version,
+            overrides={r: s for r, s in state.overrides
+                       if s != r % state.num_shards},
+            generation=state.generation)
+        shard_map.validate()
+        return shard_map
+
+    @property
+    def journal(self) -> RebalanceJournal:
+        return self._journal
+
+    # -- policy gates --------------------------------------------------
+
+    def may_move(self, now: Optional[float] = None) -> bool:
+        """True when the commit budget allows another move: fewer than
+        ``rebalance_max_moves`` commits inside the trailing
+        ``rebalance_cooldown_s`` window."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            self._commit_times = [t for t in self._commit_times
+                                  if now - t < self.cooldown_s]
+            return len(self._commit_times) < self.max_moves
+
+    def pick_target(self, rank: int) -> int:
+        """The deterministic placement choice: the least-loaded shard
+        other than ``rank``'s current home (rank count under the
+        current placement; lowest shard index breaks ties)."""
+        state = self.current_state()
+        source = state.shard_for_rank(rank)
+        loads = {shard: 0 for shard in range(state.num_shards)}
+        for r in range(state.num_trainers):
+            loads[state.shard_for_rank(r)] += 1
+        candidates = [(load, shard) for shard, load in sorted(loads.items())
+                      if shard != source]
+        if not candidates:
+            return source
+        return min(candidates)[1]
+
+    # -- decisions -----------------------------------------------------
+
+    def begin(self, rank: int, target: Optional[int] = None,
+              reason: str = "") -> Optional[PlacementDecision]:
+        """Journal an ``intent`` to move ``rank`` (to ``target``, or to
+        :meth:`pick_target`'s choice). Returns the decision, or None
+        when the move is a no-op (already home) or the commit budget is
+        exhausted. The ``rebalance_abort`` chaos site fires HERE, after
+        the intent is durable and before any actuator byte moves — the
+        "driver killed mid-decision" scenario."""
+        if not self.may_move():
+            logger.warning(
+                "rebalance: move budget exhausted (%d moves / %.1fs "
+                "window); skipping rank %d", self.max_moves,
+                self.cooldown_s, rank)
+            return None
+        state = self.current_state()
+        source = state.shard_for_rank(rank)
+        if target is None:
+            target = self.pick_target(rank)
+        decision = PlacementDecision(kind="intent", rank=int(rank),
+                                     source=int(source),
+                                     target=int(target), reason=reason)
+        if self._transition(decision) is None:
+            return None
+        from ray_shuffling_data_loader_tpu.runtime import faults as rt_faults
+        # Keyed by the move's TARGET generation (the one a commit would
+        # stamp) — the same key the actuator sites and their telemetry
+        # twins use, so the chaos<->telemetry join holds across phases.
+        rt_faults.inject("rebalance_abort", epoch=state.generation + 1,
+                         task=int(rank))
+        return decision
+
+    def commit(self, rank: int, reason: str = "") -> PlacementState:
+        """Journal the COMMIT of the pending move. From this line on
+        the target shard owns the rank: the placement generation bumps
+        (fencing the source's future frames) and consumers are
+        redirected. Strictly ordered BEFORE the source releases."""
+        pending = self.current_state().pending
+        if pending is None or pending[0] != rank:
+            raise ValueError(f"commit for rank {rank} but pending move "
+                             f"is {pending}")
+        decision = PlacementDecision(kind="commit", rank=pending[0],
+                                     source=pending[1], target=pending[2],
+                                     reason=reason)
+        state = self._transition(decision)
+        assert state is not None
+        now = time.monotonic()
+        with self._lock:
+            self._commit_times.append(now)
+            self.moves_total += 1
+        rt_metrics.counter(
+            "rsdl_rebalance_moves_total",
+            "committed live queue migrations").inc()
+        rt_metrics.gauge(
+            "rsdl_rebalance_last_move_unixtime",
+            "wall-clock time of the last committed migration").set(
+            time.time())
+        return state
+
+    def abort(self, rank: int, reason: str = "") -> PlacementState:
+        """Journal the ABORT of the pending move: the source shard
+        stays authoritative, nothing about the placement changes."""
+        pending = self.current_state().pending
+        if pending is None or pending[0] != rank:
+            raise ValueError(f"abort for rank {rank} but pending move "
+                             f"is {pending}")
+        decision = PlacementDecision(kind="abort", rank=pending[0],
+                                     source=pending[1], target=pending[2],
+                                     reason=reason)
+        state = self._transition(decision)
+        assert state is not None
+        return state
+
+    def _transition(self,
+                    decision: PlacementDecision) -> Optional[PlacementState]:
+        with self._lock:
+            state = apply_decision(self._state, decision)
+            if state == self._state:
+                return None  # no-op: never journaled
+            self._state = state
+            self._journal.record(decision, state)
+        logger.warning(
+            "rebalance: %s rank %d shard %d -> %d (generation %d)%s",
+            decision.kind, decision.rank, decision.source,
+            decision.target, state.generation,
+            f" ({decision.reason})" if decision.reason else "")
+        # ``epoch`` carries the move's TARGET generation (a commit's
+        # post-fold generation IS that number; intent/abort are one
+        # short of it) — the join key the chaos sites and the wire
+        # actuator's telemetry twins share.
+        move_gen = (state.generation if decision.kind == "commit"
+                    else state.generation + 1)
+        rt_telemetry.record(f"rebalance_{decision.kind}",
+                            epoch=(move_gen
+                                   if decision.kind in ("intent", "commit",
+                                                        "abort")
+                                   else state.generation),
+                            task=decision.rank,
+                            source=decision.source,
+                            target=decision.target,
+                            generation=state.generation,
+                            reason=decision.reason)
+        rt_metrics.counter(
+            "rsdl_rebalance_decisions_total",
+            "journaled placement decisions by kind",
+            kind=decision.kind).inc()
+        self._export(state)
+        return state
+
+    def _export(self, state: PlacementState) -> None:
+        rt_metrics.gauge(
+            "rsdl_rebalance_generation",
+            "current placement generation (bumps once per committed "
+            "migration)").set(state.generation)
+        rt_metrics.gauge(
+            "rsdl_rebalance_overrides",
+            "ranks currently living off their static home shard").set(
+            len(state.overrides))
+
+    def close(self) -> None:
+        self._journal.close()
+
+
+def migrate(controller: RebalanceController, rank: int,
+            target: Optional[int] = None, reason: str = "",
+            timeout_s: float = 30.0) -> Optional[PlacementState]:
+    """Drive one full two-phase live queue migration end to end:
+
+    1. journal ``intent`` (:meth:`RebalanceController.begin`);
+    2. **PREPARE** the source shard over the wire — it seals the rank's
+       queues at a watermark and exports a CRC'd handoff manifest
+       (unacked replay frames + birth stamps + seq cursors);
+    3. **ADOPT** the manifest on the target shard — it imports the
+       cursors and frames at the NEW placement generation;
+    4. journal ``commit`` (the point of no return — any crash before
+       this line recovers as an abort with the source authoritative);
+    5. **RELEASE** the source — it drops the rank's queues and answers
+       future GETs with a ``MOVED`` redirect to the target's address.
+
+    A wire failure between intent and commit journals an ``abort`` and
+    un-seals the source (best effort — a dead source un-seals itself by
+    restarting from its watermark journal). Returns the committed state
+    or None when no move was begun (budget or no-op)."""
+    from ray_shuffling_data_loader_tpu import multiqueue_service as mqs
+    decision = controller.begin(rank, target=target, reason=reason)
+    if decision is None:
+        return None
+    shard_map = controller.current_map()
+    generation = controller.current_state().generation + 1
+    source_addr = tuple(shard_map.addresses[decision.source])
+    target_addr = tuple(shard_map.addresses[decision.target])
+    try:
+        manifest = mqs.rebalance_prepare(source_addr, rank,
+                                         generation=generation,
+                                         timeout_s=timeout_s)
+        mqs.rebalance_adopt(target_addr, manifest, timeout_s=timeout_s)
+    except BaseException as e:
+        controller.abort(rank, reason=f"handoff failed: {e}")
+        try:
+            mqs.rebalance_unseal(source_addr, rank, timeout_s=timeout_s)
+        except OSError:
+            pass  # dead source un-seals itself at restart
+        raise
+    state = controller.commit(rank, reason=reason)
+    try:
+        mqs.rebalance_release(source_addr, rank, generation=generation,
+                              target=target_addr, timeout_s=timeout_s)
+    except OSError as e:
+        # Post-commit the target is authoritative regardless; a source
+        # that missed RELEASE keeps stamping the old generation and the
+        # client fence drops its frames loudly.
+        logger.warning("rebalance: release of rank %d on %s failed (%s); "
+                       "relying on the generation fence", rank,
+                       source_addr, e)
+    return state
+
+
+__all__ = ["PlacementDecision", "PlacementState", "RebalanceJournal",
+           "RebalanceController", "apply_decision", "replay", "migrate",
+           "DECISION_KINDS"]

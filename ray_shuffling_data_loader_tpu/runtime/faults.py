@@ -110,6 +110,11 @@ SITES = frozenset({
     # epoch/window boundary check; member_partition blackholes transport
     # frames to a dest rank; member_flap starves one probe round.
     "member_crash", "member_partition", "member_flap",
+    # Rebalance plane (rebalance/): live queue migration phases. Each
+    # site models the whole process dying at that exact phase — source
+    # mid-PREPARE, target mid-COMMIT, driver mid-decision — keyed by
+    # (epoch = the move's target placement generation, task = rank).
+    "rebalance_prepare", "rebalance_commit", "rebalance_abort",
 })
 
 _SPEC_ENVS = ("RSDL_CHAOS_SPEC", "RSDL_FAULTS_SPEC")

@@ -530,6 +530,66 @@ class TenantCacheThrashDetector(Detector):
         return None
 
 
+_TENANT_DELIVERY_CENTROID_SERIES = \
+    "rsdl_tenant_delivery_latency_seconds_centroid"
+
+
+class TenantDeliverySLODetector(Detector):
+    """Sustained per-tenant delivery-p99 SLO breach — the rebalance
+    trigger.
+
+    Same windowed centroid-delta math as
+    :class:`DeliveryLatencyDetector`, evaluated over the per-tenant
+    sketch the wire client feeds (``rsdl_tenant_delivery_latency_seconds``
+    with ``hop=birth_to_delivered``) and breaching on the WORST tenant.
+    The threshold is the rebalance plane's own knob
+    (``RSDL_REBALANCE_SLO_P99_S``), not the generic delivery SLO: this
+    detector's consumer is the :mod:`rebalance` controller, and its
+    hysteresis (``HealthMonitor``'s fire/clear tick runs) is what turns
+    a noisy latency series into exactly one migration per episode."""
+
+    name = "tenant_delivery_slo"
+
+    def __init__(self, component: str = "health", **overrides: Any):
+        super().__init__(component, **overrides)
+        self.p99_s = self._resolve("rebalance_slo_p99_s")
+        self.window_ticks = self._resolve("slo_droop_window_ticks")
+
+    def evaluate(self, ring: rt_history.HistoryRing) -> Optional[Breach]:
+        snaps = ring.snapshots()
+        if len(snaps) < 2:
+            return None
+        window = max(1, int(self.window_ticks))
+        now = snaps[-1]["samples"].get(_TENANT_DELIVERY_CENTROID_SERIES)
+        if not now:
+            return None
+        base = snaps[max(0, len(snaps) - 1 - window)]["samples"].get(
+            _TENANT_DELIVERY_CENTROID_SERIES, {})
+        delta = {}
+        for labels, value in now.items():
+            d = value - base.get(labels, 0.0)
+            if d > 0:
+                delta[labels] = d
+        if not delta:
+            return None
+        stats = rt_metrics.sketch_quantiles(
+            {_TENANT_DELIVERY_CENTROID_SERIES: delta},
+            "rsdl_tenant_delivery_latency_seconds", qs=(0.99,),
+            hop="birth_to_delivered")
+        worst = None
+        for labels, entry in stats.items():
+            tenant = dict(labels).get("tenant", "?")
+            if worst is None or entry["p99"] > worst[0]:
+                worst = (entry["p99"], tenant, int(entry["count"]))
+        if worst is not None and worst[0] > self.p99_s:
+            p99, tenant, count = worst
+            return self._breach(
+                p99, self.p99_s,
+                f"tenant {tenant} delivery p99 {p99:.2f}s over the last "
+                f"{count} frame(s) (rebalance SLO {self.p99_s:.2f}s)")
+        return None
+
+
 class WatermarkLagDetector(Detector):
     """Streaming ingest running away from serving.
 
@@ -565,7 +625,8 @@ _DETECTOR_TYPES: Dict[str, type] = {
         ThroughputDroopDetector, StallBreachDetector, LedgerCreepDetector,
         QueueSaturationDetector, LeaseChurnDetector, StragglerDriftDetector,
         DeliveryLatencyDetector, FreshnessStallDetector, CacheThrashDetector,
-        TenantCacheThrashDetector, WatermarkLagDetector)
+        TenantCacheThrashDetector, TenantDeliverySLODetector,
+        WatermarkLagDetector)
 }
 
 

@@ -107,6 +107,15 @@ METRIC_NAMES: Dict[str, Tuple[str, Tuple[str, ...]]] = {
     "rsdl_member_transitions_total": ("counter", ("kind",)),
     "rsdl_member_fenced_frames_total": ("counter", ()),
     "rsdl_member_last_transition_unixtime": ("gauge", ()),
+    # -- rebalance plane (rebalance/ + the serving-plane actuator in
+    #    multiqueue_service.py): journaled placement decisions, the
+    #    placement-generation fence, and move accounting --
+    "rsdl_rebalance_generation": ("gauge", ()),
+    "rsdl_rebalance_overrides": ("gauge", ()),
+    "rsdl_rebalance_decisions_total": ("counter", ("kind",)),
+    "rsdl_rebalance_moves_total": ("counter", ()),
+    "rsdl_rebalance_last_move_unixtime": ("gauge", ()),
+    "rsdl_rebalance_fenced_frames_total": ("counter", ()),
     # -- spill tier (spill.py) --
     "rsdl_spills_total": ("counter", ()),
     "rsdl_spilled_bytes_total": ("counter", ()),

@@ -340,6 +340,16 @@ _KEYS: Dict[str, "tuple[Any, Callable[[str], Any]]"] = {
     # the stream is declared stale — the streaming analog of
     # slo_freshness_s, measured in seconds of stream time.
     "slo_watermark_lag_s": (300.0, float),
+    # Self-healing rebalancer (rebalance/, RSDL_REBALANCE_*): the
+    # per-tenant delivery-p99 SLO above which the tenant_delivery_slo
+    # detector declares a sustained breach (the trigger for a journaled
+    # placement decision), the cooldown after a committed move before
+    # the controller will consider another (lets the post-move p99
+    # window drain so one hot tenant does not ping-pong between
+    # shards), and the max committed moves per decision window.
+    "rebalance_slo_p99_s": (30.0, float),
+    "rebalance_cooldown_s": (60.0, float),
+    "rebalance_max_moves": (1, int),
 }
 
 _lock = threading.Lock()

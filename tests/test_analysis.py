@@ -601,6 +601,30 @@ FIXED_WORLD_OK = """
         return placement
 """
 
+SHARD_AFFINITY_MOD_BAD = """
+    def route(self, rank):
+        # static placement formula: stale after a live migration
+        shard = rank % self.num_shards
+        return shard
+"""
+
+SHARD_AFFINITY_ADDR_BAD = """
+    def __init__(self, shard_map, queue_idx):
+        # caches a (host, port) a committed migration invalidates
+        shard = shard_map.shard_for_queue(queue_idx)
+        self._addr = shard_map.addresses[shard]
+"""
+
+SHARD_AFFINITY_OK = """
+    from ray_shuffling_data_loader_tpu.plan import ir as plan_ir
+
+    def route(self, shard_map, queue_idx):
+        # placement + address queried from the live shard map per call
+        shard = shard_map.shard_for_queue(queue_idx)
+        host, port = shard_map.address_for_queue(queue_idx)
+        return shard, (host, port)
+"""
+
 TENANT_BYPASS_BAD = """
     def register(self, kind, name, nbytes):
         # A shared-plane entry point admitting work with no idea whose
@@ -699,6 +723,12 @@ CASES = [
      {"path": "ray_shuffling_data_loader_tpu/multiqueue_service.py"}),
     ("fixed-world-assumption", FIXED_WORLD_SCALE_BAD, FIXED_WORLD_OK,
      {"path": "ray_shuffling_data_loader_tpu/shuffle.py"}),
+    ("shard-affinity-assumption", SHARD_AFFINITY_MOD_BAD,
+     SHARD_AFFINITY_OK,
+     {"path": "ray_shuffling_data_loader_tpu/dataset.py"}),
+    ("shard-affinity-assumption", SHARD_AFFINITY_ADDR_BAD,
+     SHARD_AFFINITY_OK,
+     {"path": "ray_shuffling_data_loader_tpu/runtime/supervisor.py"}),
     ("tenant-context-bypass", TENANT_BYPASS_BAD, TENANT_BYPASS_PARAM_OK,
      {"path": "ray_shuffling_data_loader_tpu/storage/remote.py"}),
     ("tenant-context-bypass", TENANT_BYPASS_BAD, TENANT_BYPASS_AMBIENT_OK,
@@ -750,6 +780,21 @@ def test_static_epoch_assumption_scoped_to_library_code():
     flagged, _ = lint(STATIC_EPOCH_RANGE_BAD,
                       path="ray_shuffling_data_loader_tpu/jax_dataset.py")
     assert "static-epoch-assumption" in flagged
+
+
+def test_shard_affinity_assumption_scoped_to_library_code():
+    """plan/ owns placement arithmetic, rebalance/ rewrites it, and the
+    serving plane implements the MOVED redirect — all exempt; tests and
+    tools derive shards freely."""
+    for exempt in ("ray_shuffling_data_loader_tpu/plan/ir.py",
+                   "ray_shuffling_data_loader_tpu/rebalance/__init__.py",
+                   "ray_shuffling_data_loader_tpu/multiqueue_service.py",
+                   "tests/test_x.py", "tools/rsdl_top.py"):
+        flagged, _ = lint(SHARD_AFFINITY_MOD_BAD, path=exempt)
+        assert "shard-affinity-assumption" not in flagged, exempt
+    flagged, _ = lint(SHARD_AFFINITY_ADDR_BAD,
+                      path="ray_shuffling_data_loader_tpu/dataset.py")
+    assert "shard-affinity-assumption" in flagged
 
 
 def test_fixed_world_assumption_scoped_to_library_code():

@@ -124,6 +124,23 @@ DEFAULT_RULES: List[Dict[str, Any]] = [
      "slack": 250.0},
     {"key": "rows_lost", "mode": "ceiling", "limit": 0.0},
     {"key": "elastic_ok", "mode": "require_true"},
+    # Self-healing serving-plane leg (rebalance/): the SLO tenant's p99
+    # recovery across the live migration must stay well above 1x (the
+    # leg's own rebalance_ok already pins > 1.0 — the relative rule
+    # catches the slow slide a boolean can't), the seal window is
+    # latency physics (wide + slack for shared-host jitter), and the
+    # delivered rate across the move is feed-paced and so nearly
+    # deterministic. rows_lost rides the elastic ceiling above
+    # (max-merged in bench.py when both legs run); rebalance_ok is the
+    # exactly-once + breach-driven-decision + journal-replay verdict.
+    # Records older than r12 lack these keys; relative and require_true
+    # rules skip cleanly.
+    {"key": "rebalance_p99_recovery_x", "mode": "lower_bad", "pct": 50.0},
+    {"key": "rebalance_stall_ms", "mode": "higher_bad", "pct": 200.0,
+     "slack": 250.0},
+    {"key": "rebalance_slo_rows_per_sec", "mode": "lower_bad",
+     "pct": 25.0},
+    {"key": "rebalance_ok", "mode": "require_true"},
 ]
 
 

@@ -361,6 +361,38 @@ def render_membership(parsed: dict) -> list:
     return [line]
 
 
+def render_rebalance(parsed: dict) -> list:
+    """One rebalance line (rebalance/): placement generation, live
+    override count, decisions by kind, fenced zombie frames, and the
+    age of the last committed move — the "did the serving plane just
+    move a rank, and did anything leak" one-liner. Silent when no
+    rebalance controller ever ran."""
+    import time as _time
+    generation = _scalar(parsed, "rsdl_rebalance_generation")
+    decisions = _by_label(parsed, "rsdl_rebalance_decisions_total", "kind")
+    if not generation and not decisions:
+        return []
+    overrides = _scalar(parsed, "rsdl_rebalance_overrides")
+    moves = _scalar(parsed, "rsdl_rebalance_moves_total")
+    fenced = _scalar(parsed, "rsdl_rebalance_fenced_frames_total")
+    line = (f"rebalance: generation {int(generation)}   "
+            f"moves {int(moves)}   overrides {int(overrides)}")
+    if decisions:
+        detail = " ".join(f"{kind}={int(n)}"
+                          for kind, n in sorted(decisions.items()))
+        line += f"   decisions {detail}"
+    last = _scalar(parsed, "rsdl_rebalance_last_move_unixtime")
+    if last:
+        # Cross-process age: the gauge IS a serialized wall-clock
+        # timestamp, so wall clock is the only comparable clock here.
+        # rsdl-lint: disable=wallclock-interval
+        age = max(0.0, _time.time() - last)
+        line += f"   last move {age:.0f}s ago"
+    if fenced:
+        line += f"   FENCED {int(fenced)}"
+    return [line]
+
+
 def render_latency(parsed: dict, before: dict = None) -> list:
     """Per-queue delivery-latency lines (runtime/latency.py sketch):
     p50/p95/p99 of the end-to-end birth->delivered hop plus the queue's
@@ -513,6 +545,7 @@ def render(parsed: dict, before: dict = None, interval_s: float = None
     lines.extend(render_storage(parsed))
     lines.extend(render_tenants(parsed))
     lines.extend(render_membership(parsed))
+    lines.extend(render_rebalance(parsed))
     lines.extend(render_streaming(parsed))
     lines.extend(render_latency(parsed, before=before if rate_mode
                                 else None))
